@@ -1,0 +1,13 @@
+"""tpu-gang-scheduler: a TPU-native gang-scheduling framework.
+
+A ground-up rebuild of the capabilities of palantir/k8s-spark-scheduler
+(reference mounted read-only at /root/reference): a Kubernetes scheduler
+extender that admits a Spark driver only when the whole application
+(driver + executors) fits, with reservation objects, FIFO ordering,
+dynamic-allocation soft reservations, autoscaler demand signaling, and
+failover reconciliation.  The packing math runs as a JAX/XLA batch solver
+with the node axis sharded over the TPU mesh (`binpack: tpu-batch`),
+validated decision-for-decision against exact CPU oracles.
+"""
+
+__version__ = "0.1.0"

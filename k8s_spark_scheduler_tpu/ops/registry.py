@@ -1,0 +1,59 @@
+"""Binpacker registry (reference ``internal/binpacker/binpack.go``).
+
+Name → algorithm map with the reference's names plus the TPU-native
+``tpu-batch`` solver.  Unknown names fall back to the default
+``distribute-evenly`` (binpack.go:52-58).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import packers
+from .packers import SparkBinPackFunction
+
+TIGHTLY_PACK = "tightly-pack"
+DISTRIBUTE_EVENLY = "distribute-evenly"
+AZ_AWARE_TIGHTLY_PACK = "az-aware-tightly-pack"
+SINGLE_AZ_TIGHTLY_PACK = "single-az-tightly-pack"
+SINGLE_AZ_MINIMAL_FRAGMENTATION = "single-az-minimal-fragmentation"
+MINIMAL_FRAGMENTATION = "minimal-fragmentation"
+TPU_BATCH = "tpu-batch"
+
+DEFAULT = DISTRIBUTE_EVENLY
+
+
+@dataclass
+class Binpacker:
+    name: str
+    binpack_func: SparkBinPackFunction
+    is_single_az: bool
+
+
+_REGISTRY = {}
+
+
+def register(name: str, fn: SparkBinPackFunction, is_single_az: bool) -> None:
+    _REGISTRY[name] = Binpacker(name, fn, is_single_az)
+
+
+register(TIGHTLY_PACK, packers.tightly_pack, False)
+register(DISTRIBUTE_EVENLY, packers.distribute_evenly, False)
+register(AZ_AWARE_TIGHTLY_PACK, packers.az_aware_tightly_pack, True)
+register(SINGLE_AZ_TIGHTLY_PACK, packers.single_az_tightly_pack, True)
+register(SINGLE_AZ_MINIMAL_FRAGMENTATION, packers.single_az_minimal_fragmentation, True)
+register(MINIMAL_FRAGMENTATION, packers.minimal_fragmentation_pack, False)
+
+
+def select_binpacker(name: str) -> Binpacker:
+    """binpack.go:52-58; unknown → distribute-evenly."""
+    if name == TPU_BATCH:
+        # imported lazily: pulls in jax
+        from .batch_adapter import tpu_batch_binpacker
+
+        return tpu_batch_binpacker()
+    return _REGISTRY.get(name, _REGISTRY[DEFAULT])
+
+
+def available_binpackers() -> list[str]:
+    return sorted(_REGISTRY.keys() | {TPU_BATCH})

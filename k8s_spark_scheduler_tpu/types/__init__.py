@@ -1,0 +1,6 @@
+from .resources import (
+    NodeGroupResources,
+    NodeGroupSchedulingMetadata,
+    NodeSchedulingMetadata,
+    Resources,
+)

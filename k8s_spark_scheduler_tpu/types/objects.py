@@ -1,0 +1,260 @@
+"""API object model: pods, nodes, reservations, demands.
+
+Covers the reference's CRD types
+(``lib/pkg/apis/sparkscheduler/v1beta2/types_resource_reservation.go:51-57``,
+``lib/pkg/apis/scaler/v1alpha2/types_demand.go:72-157``) and the small
+subset of core/v1 Pod + Node the scheduler reads.  The objects are plain
+dataclasses with dict (de)serialization so they can live in the embedded
+state store, be diffed by resourceVersion, and round-trip through JSON.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.quantity import Quantity
+from .resources import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_NVIDIA_GPU,
+    Resources,
+)
+
+_monotonic_counter = itertools.count(1)
+
+
+def now() -> float:
+    return time.time()
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+    uid: str = ""
+    owner_references: List["OwnerReference"] = field(default_factory=list)
+
+    def ensure_identity(self) -> None:
+        if not self.uid:
+            self.uid = f"uid-{next(_monotonic_counter)}"
+        if not self.creation_timestamp:
+            self.creation_timestamp = now()
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+
+class APIObject:
+    """Base for objects stored in the state store."""
+
+    meta: ObjectMeta
+    KIND: str = "Object"
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def namespace(self) -> str:
+        return self.meta.namespace
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self.meta.labels
+
+    @property
+    def annotations(self) -> Dict[str, str]:
+        return self.meta.annotations
+
+    @property
+    def creation_timestamp(self) -> float:
+        return self.meta.creation_timestamp
+
+    def deepcopy(self):
+        return _copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# core/v1 subset
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    requests: Resources = field(default_factory=Resources.zero)
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class Pod(APIObject):
+    KIND = "Pod"
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    scheduler_name: str = ""
+    node_name: str = ""  # spec.nodeName: set on bind
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # required node affinity match expressions: label → allowed values
+    # (the reference extracts instance group from nodeAffinity/nodeSelector,
+    # internal/podspec.go:29-53)
+    node_affinity: Dict[str, List[str]] = field(default_factory=dict)
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    phase: str = PodPhase.PENDING
+    # per-container terminated flags used by IsPodTerminated
+    # (internal/common/utils/pods.go:69-75: terminated iff there is at
+    # least one container status and all are terminated)
+    container_terminated: List[bool] = field(default_factory=list)
+    conditions: Dict[str, "PodCondition"] = field(default_factory=dict)
+
+    def is_terminated(self) -> bool:
+        return len(self.container_terminated) > 0 and all(self.container_terminated)
+
+    def matches_node(self, node: "Node") -> bool:
+        """Required node affinity + nodeSelector match."""
+        for k, v in self.node_selector.items():
+            if node.labels.get(k) != v:
+                return False
+        for k, values in self.node_affinity.items():
+            if node.labels.get(k) not in values:
+                return False
+        return True
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str  # "True" / "False"
+    reason: str = ""
+    message: str = ""
+    transition_time: float = 0.0
+
+
+@dataclass
+class Node(APIObject):
+    KIND = "Node"
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    allocatable: Resources = field(default_factory=Resources.zero)
+    unschedulable: bool = False
+    ready: bool = True
+
+    @property
+    def zone(self) -> str:
+        from .resources import ZONE_LABEL, ZONE_LABEL_PLACEHOLDER
+
+        return self.labels.get(ZONE_LABEL, ZONE_LABEL_PLACEHOLDER)
+
+
+# ---------------------------------------------------------------------------
+# ResourceReservation (v1beta2 storage schema,
+# types_resource_reservation.go:23-103)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Reservation:
+    node: str
+    resources: Dict[str, Quantity] = field(default_factory=dict)
+
+    @staticmethod
+    def for_resources(node: str, r: Resources) -> "Reservation":
+        return Reservation(
+            node=node,
+            resources={
+                RESOURCE_CPU: r.cpu,
+                RESOURCE_MEMORY: r.memory,
+                RESOURCE_NVIDIA_GPU: r.nvidia_gpu,
+            },
+        )
+
+    def resources_value(self) -> Resources:
+        return Resources(
+            self.resources.get(RESOURCE_CPU, Quantity(0)),
+            self.resources.get(RESOURCE_MEMORY, Quantity(0)),
+            self.resources.get(RESOURCE_NVIDIA_GPU, Quantity(0)),
+        )
+
+
+@dataclass
+class ResourceReservationSpec:
+    reservations: Dict[str, Reservation] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceReservationStatus:
+    # reservation name → bound pod name (types_resource_reservation.go:99-103)
+    pods: Dict[str, str] = field(default_factory=dict)
+
+
+APP_ID_LABEL = "spark-app-id"
+
+
+@dataclass
+class ResourceReservation(APIObject):
+    KIND = "ResourceReservation"
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceReservationSpec = field(default_factory=ResourceReservationSpec)
+    status: ResourceReservationStatus = field(default_factory=ResourceReservationStatus)
+
+
+# ---------------------------------------------------------------------------
+# Demand (v1alpha2 storage schema, types_demand.go:29-157)
+# ---------------------------------------------------------------------------
+
+
+class DemandPhase:
+    EMPTY = ""
+    PENDING = "pending"
+    FULFILLED = "fulfilled"
+    CANNOT_FULFILL = "cannot-fulfill"
+
+
+@dataclass
+class DemandUnit:
+    resources: Resources
+    count: int
+    # pod names this unit is for, keyed by namespace
+    pod_names_by_namespace: Dict[str, List[str]] = field(default_factory=dict)
+
+
+@dataclass
+class DemandSpec:
+    units: List[DemandUnit] = field(default_factory=list)
+    instance_group: str = ""
+    is_long_lived: bool = False
+    enforce_single_zone_scheduling: bool = False
+    zone: Optional[str] = None
+
+
+@dataclass
+class DemandStatus:
+    phase: str = DemandPhase.EMPTY
+    last_transition_time: float = 0.0
+    fulfilled_zone: Optional[str] = None
+
+
+@dataclass
+class Demand(APIObject):
+    KIND = "Demand"
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DemandSpec = field(default_factory=DemandSpec)
+    status: DemandStatus = field(default_factory=DemandStatus)

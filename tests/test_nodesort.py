@@ -1,0 +1,84 @@
+"""Node-priority ordering tests (reference internal/sort/nodesorting_test.go
+scenarios re-derived)."""
+
+from k8s_spark_scheduler_tpu.ops.nodesort import LabelPriorityOrder, NodeSorter
+from k8s_spark_scheduler_tpu.types.resources import (
+    NodeSchedulingMetadata,
+    Resources,
+)
+
+
+def md(cpu, mem, zone="default", labels=None, unschedulable=False, ready=True):
+    return NodeSchedulingMetadata(
+        available=Resources.of(cpu, mem),
+        schedulable=Resources.of(cpu, mem),
+        zone_label=zone,
+        all_labels=labels or {},
+        unschedulable=unschedulable,
+        ready=ready,
+    )
+
+
+def test_sorted_ascending_by_memory_then_cpu():
+    metadata = {
+        "big": md(8, "8Gi"),
+        "small": md(1, "1Gi"),
+        "mid": md(4, "4Gi"),
+        "midcpu": md(2, "4Gi"),
+    }
+    driver, executor = NodeSorter().potential_nodes(metadata, list(metadata))
+    assert driver == ["small", "midcpu", "mid", "big"]
+    assert executor == driver
+
+
+def test_az_with_less_resources_first():
+    metadata = {
+        "z2a": md(8, "8Gi", "z2"),
+        "z1a": md(1, "1Gi", "z1"),
+        "z1b": md(2, "2Gi", "z1"),
+        "z2b": md(1, "2Gi", "z2"),
+    }
+    # z1 total mem 3Gi < z2 total 10Gi → all z1 nodes first
+    driver, _ = NodeSorter().potential_nodes(metadata, list(metadata))
+    assert driver == ["z1a", "z1b", "z2b", "z2a"]
+
+
+def test_missing_zone_label_uses_placeholder():
+    metadata = {
+        "a": md(1, "1Gi"),  # placeholder zone
+        "b": md(2, "2Gi", "z1"),
+    }
+    driver, _ = NodeSorter().potential_nodes(metadata, list(metadata))
+    assert set(driver) == {"a", "b"}
+
+
+def test_driver_candidates_intersect_kube_list_executors_schedulable():
+    metadata = {
+        "a": md(1, "1Gi"),
+        "b": md(2, "2Gi"),
+        "cordoned": md(1, "512Mi", unschedulable=True),
+        "notready": md(1, "512Mi", ready=False),
+    }
+    driver, executor = NodeSorter().potential_nodes(metadata, ["a", "cordoned", "notready"])
+    # driver list: all sorted nodes ∩ kube candidates (even cordoned ones)
+    assert driver == ["cordoned", "notready", "a"]
+    # executor list: only schedulable + ready
+    assert executor == ["a", "b"]
+
+
+def test_label_priority_stable_resort():
+    metadata = {
+        "gold1": md(1, "1Gi", labels={"tier": "gold"}),
+        "silver": md(2, "2Gi", labels={"tier": "silver"}),
+        "gold2": md(4, "4Gi", labels={"tier": "gold"}),
+        "none": md(3, "3Gi"),
+    }
+    sorter = NodeSorter(
+        driver_prioritized_node_label=LabelPriorityOrder("tier", ["gold", "silver"])
+    )
+    driver, executor = sorter.potential_nodes(metadata, list(metadata))
+    # gold nodes first (stable: resource order preserved within rank),
+    # then silver, then unlabeled
+    assert driver == ["gold1", "gold2", "silver", "none"]
+    # executor order untouched (no executor label config)
+    assert executor == ["gold1", "silver", "none", "gold2"]

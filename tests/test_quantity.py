@@ -1,0 +1,78 @@
+"""Quantity parsing/arithmetic parity with k8s resource.Quantity."""
+
+from fractions import Fraction
+
+import pytest
+
+from k8s_spark_scheduler_tpu.utils.quantity import Quantity
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("0", Fraction(0)),
+        ("1", Fraction(1)),
+        ("100m", Fraction(1, 10)),
+        ("1500m", Fraction(3, 2)),
+        ("2.5", Fraction(5, 2)),
+        ("4Gi", Fraction(4 * 2**30)),
+        ("512Mi", Fraction(512 * 2**20)),
+        ("1G", Fraction(10**9)),
+        ("1k", Fraction(1000)),
+        ("1Ki", Fraction(1024)),
+        ("1e3", Fraction(1000)),
+        ("1E3", Fraction(1000)),
+        ("1E", Fraction(10**18)),
+        ("-500m", Fraction(-1, 2)),
+        ("+2", Fraction(2)),
+        (".5", Fraction(1, 2)),
+        ("0.1", Fraction(1, 10)),
+        ("100n", Fraction(100, 10**9)),
+        ("15u", Fraction(15, 10**6)),
+        ("1.5Gi", Fraction(3 * 2**29)),
+    ],
+)
+def test_parse(text, expected):
+    assert Quantity(text).exact == expected
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1.2.3", "1ee3", "Gi", "--1", "1 Gi"])
+def test_parse_errors(bad):
+    with pytest.raises(ValueError):
+        Quantity(bad)
+
+
+def test_value_ceils():
+    assert Quantity("100m").value() == 1  # k8s Value() rounds up
+    assert Quantity("1").value() == 1
+    assert Quantity("1500m").value() == 2
+    assert Quantity("2.5").milli_value() == 2500
+    assert Quantity("1n").milli_value() == 1  # ceil
+
+
+def test_arithmetic_exact():
+    a = Quantity("0.1")
+    total = Quantity(0)
+    for _ in range(10):
+        total = total.add(a)
+    assert total == Quantity("1")  # no float drift
+
+    assert Quantity("1Gi").sub(Quantity("512Mi")) == Quantity("512Mi")
+    assert Quantity("2").cmp(Quantity("2000m")) == 0
+    assert Quantity("2").cmp(Quantity("2001m")) == -1
+
+
+def test_serialize_roundtrip():
+    for s in ["4Gi", "100m", "3", "1e3"]:
+        q = Quantity(s)
+        assert Quantity(q.serialize()) == q
+    # computed values serialize parseably too
+    q = Quantity("1Gi").sub(Quantity("1"))
+    assert Quantity(q.serialize()) == q
+
+
+def test_milli_exactness_flag():
+    _, exact = Quantity("100m").milli_value_exact()
+    assert exact
+    _, exact = Quantity("100u").milli_value_exact()
+    assert not exact

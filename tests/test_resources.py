@@ -1,0 +1,56 @@
+"""Resource algebra parity (reference lib/pkg/resources)."""
+
+from k8s_spark_scheduler_tpu.types.resources import (
+    NodeSchedulingMetadata,
+    Resources,
+    group_add,
+    group_sub,
+    subtract_usage_if_exists,
+)
+
+
+def R(cpu, mem, gpu=0):
+    return Resources.of(cpu, mem, gpu)
+
+
+def test_greater_than_is_any_dimension():
+    # resources.go:239-241: any dimension greater → true
+    assert R(2, 1).greater_than(R(1, 5))
+    assert R(1, 5).greater_than(R(2, 1))
+    assert not R(1, 1).greater_than(R(1, 1))
+    assert not R(1, 1).greater_than(R(2, 2))
+    assert R(0, 0, 1).greater_than(R(5, 5, 0))
+
+
+def test_add_sub_set_max():
+    a = R("1500m", "1Gi", 1)
+    b = R("500m", "1Gi", 0)
+    assert a.add(b).eq(R("2", "2Gi", 1))
+    assert a.sub(b).eq(R("1", 0, 1))
+    assert a.set_max(b).eq(a)
+    assert R(1, "3Gi").set_max(R(2, "1Gi")).eq(R(2, "3Gi"))
+
+
+def test_negative_available_allowed():
+    # availability can go negative after overhead subtraction; fits checks
+    # still behave (anything positive is greater than a negative avail)
+    avail = R(1, "1Gi").sub(R(2, "2Gi"))
+    assert R("1m", 0).greater_than(avail)
+
+
+def test_group_helpers():
+    g = {"a": R(1, 1)}
+    group_add(g, {"a": R(1, 1), "b": R(2, 2)})
+    assert g["a"].eq(R(2, 2)) and g["b"].eq(R(2, 2))
+    group_sub(g, {"b": R(1, 1), "c": R(1, 0)})
+    assert g["b"].eq(R(1, 1))
+    assert g["c"].eq(R(-1, 0))
+
+
+def test_subtract_usage_if_exists_ignores_unknown_nodes():
+    md = {
+        "n1": NodeSchedulingMetadata(available=R(4, "4Gi"), schedulable=R(8, "8Gi")),
+    }
+    subtract_usage_if_exists(md, {"n1": R(1, "1Gi"), "ghost": R(9, "9Gi")})
+    assert md["n1"].available.eq(R(3, "3Gi"))
+    assert "ghost" not in md
