@@ -1,0 +1,172 @@
+"""Shared informers + listers over the embedded API server.
+
+The reference's read path is client-go shared informers (watch + 30s
+resync, cmd/server.go:91-92); handlers get add/update/delete events and
+listers serve label-selected reads from the informer's local store.  This
+module reproduces that shape: an :class:`Informer` keeps a local mirror
+fed by watch events and dispatches to registered handlers; a
+:class:`Lister` reads the mirror.
+
+Event delivery is synchronous with the mutation (the embedded server
+commits before notifying), which is strictly *fresher* than client-go's
+eventually-consistent delivery — any reconcile logic correct under the
+reference's staleness is correct here.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..types.objects import APIObject, Pod
+from .apiserver import ADDED, APIServer, DELETED, MODIFIED
+
+Handler = Callable[[APIObject], None]
+UpdateHandler = Callable[[APIObject, APIObject], None]
+
+
+class Informer:
+    """A shared informer for one kind."""
+
+    # bound on remembered last-seen resourceVersions for departed objects
+    # (guards against a late stale MODIFIED resurrecting a deleted object)
+    _TOMBSTONE_LIMIT = 16384
+
+    def __init__(self, api: APIServer, kind: str):
+        self._api = api
+        self.kind = kind
+        self._lock = threading.RLock()
+        self._store: Dict[Tuple[str, str], APIObject] = {}
+        # key → highest resourceVersion ever delivered; events are globally
+        # ordered by rv at the server, so delivery races are filtered here
+        self._last_rv: Dict[Tuple[str, str], int] = {}
+        self._add_handlers: List[Handler] = []
+        self._update_handlers: List[UpdateHandler] = []
+        self._delete_handlers: List[Handler] = []
+        self._synced = False
+
+    def start(self) -> None:
+        self._api.watch(self.kind, self._on_event)
+        self._synced = True
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    def _on_event(self, event: str, obj: APIObject) -> None:
+        key = (obj.namespace, obj.name)
+        with self._lock:
+            # drop out-of-order deliveries: the server's rv is a global
+            # monotonic commit order, so a lower rv is a stale event
+            rv = obj.meta.resource_version
+            if rv <= self._last_rv.get(key, -1):
+                return
+            self._last_rv[key] = rv
+            if len(self._last_rv) > self._TOMBSTONE_LIMIT:
+                # prune entries for objects we no longer mirror
+                self._last_rv = {
+                    k: v for k, v in self._last_rv.items() if k in self._store
+                }
+            old = self._store.get(key)
+            if event == DELETED:
+                self._store.pop(key, None)
+            else:
+                self._store[key] = obj
+            add_handlers = list(self._add_handlers)
+            update_handlers = list(self._update_handlers)
+            delete_handlers = list(self._delete_handlers)
+        if event == ADDED:
+            for h in add_handlers:
+                h(obj)
+        elif event == MODIFIED:
+            for h in update_handlers:
+                h(old, obj)
+            if old is None:  # replayed as modify before sync: treat as add
+                for h in add_handlers:
+                    h(obj)
+        elif event == DELETED:
+            for h in delete_handlers:
+                h(obj)
+
+    def add_event_handler(
+        self,
+        on_add: Optional[Handler] = None,
+        on_update: Optional[UpdateHandler] = None,
+        on_delete: Optional[Handler] = None,
+        filter_func: Optional[Callable[[APIObject], bool]] = None,
+    ) -> None:
+        """client-go FilteringResourceEventHandler equivalent."""
+
+        def wrap_add(obj):
+            if on_add and (filter_func is None or filter_func(obj)):
+                on_add(obj)
+
+        def wrap_update(old, new):
+            if on_update and (filter_func is None or filter_func(new)):
+                on_update(old, new)
+
+        def wrap_delete(obj):
+            if on_delete and (filter_func is None or filter_func(obj)):
+                on_delete(obj)
+
+        with self._lock:
+            if on_add:
+                self._add_handlers.append(wrap_add)
+            if on_update:
+                self._update_handlers.append(wrap_update)
+            if on_delete:
+                self._delete_handlers.append(wrap_delete)
+
+    # -- lister interface ----------------------------------------------------
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[APIObject]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._store.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and any(
+                    obj.labels.get(k) != v for k, v in label_selector.items()
+                ):
+                    continue
+                out.append(obj)
+            return out
+
+    def get(self, namespace: str, name: str) -> Optional[APIObject]:
+        with self._lock:
+            return self._store.get((namespace, name))
+
+    def list_with_predicate(self, predicate: Callable[[APIObject], bool]) -> List[APIObject]:
+        """utils.ListWithPredicate (internal/common/utils/pods.go:110-128)."""
+        with self._lock:
+            return [o for o in self._store.values() if predicate(o)]
+
+
+class InformerFactory:
+    """Shared-informer factory: one informer per kind."""
+
+    def __init__(self, api: APIServer):
+        self._api = api
+        self._informers: Dict[str, Informer] = {}
+        self._lock = threading.Lock()
+
+    def informer(self, kind: str) -> Informer:
+        with self._lock:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = Informer(self._api, kind)
+                self._informers[kind] = inf
+            return inf
+
+    def start(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            if not inf.has_synced():
+                inf.start()
+
+    def wait_for_cache_sync(self) -> bool:
+        return all(inf.has_synced() for inf in self._informers.values())

@@ -31,18 +31,6 @@ class LabelPriorityOrder:
     descending_priority_values: List[str]
 
 
-def _resources_less_than(left: Resources, right: Resources) -> bool:
-    """Memory more important than CPU (nodesorting.go:72-78)."""
-    mem = left.memory.cmp(right.memory)
-    if mem != 0:
-        return mem == -1
-    return left.cpu.cmp(right.cpu) == -1
-
-
-def _node_sort_key(md_available: Resources, name: str):
-    return (md_available.memory.exact, md_available.cpu.exact, name)
-
-
 def get_node_names_in_priority_order(metadata: NodeGroupSchedulingMetadata) -> List[str]:
     """nodesorting.go:95-122."""
     by_az: Dict[str, List[str]] = {}

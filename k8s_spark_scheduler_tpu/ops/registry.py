@@ -48,10 +48,13 @@ register(MINIMAL_FRAGMENTATION, packers.minimal_fragmentation_pack, False)
 def select_binpacker(name: str) -> Binpacker:
     """binpack.go:52-58; unknown → distribute-evenly."""
     if name == TPU_BATCH:
-        # imported lazily: pulls in jax
-        from .batch_adapter import tpu_batch_binpacker
+        try:
+            # imported lazily: pulls in jax
+            from .batch_adapter import tpu_batch_binpacker
 
-        return tpu_batch_binpacker()
+            return tpu_batch_binpacker()
+        except ImportError:
+            return _REGISTRY[DEFAULT]
     return _REGISTRY.get(name, _REGISTRY[DEFAULT])
 
 

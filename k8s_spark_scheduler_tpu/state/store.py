@@ -1,0 +1,185 @@
+"""Write-through object store + sharded unique write queue.
+
+Reproduces ``internal/cache/store/`` exactly: an ObjectStore whose Put
+preserves the currently-known resourceVersion (store.go:51-59), an
+OverrideResourceVersionIfNewer that folds informer truth back in by
+numeric comparison (store.go:62-76), and a sharded queue that dedupes
+inflight create/update requests per key while always enqueuing deletes
+(queue.go:58-92), with fnv32a shard selection so writes for the same
+object serialize (queue.go:123-128).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..types.objects import APIObject
+
+Key = Tuple[str, str]  # (namespace, name)
+
+
+def key_of(obj: APIObject) -> Key:
+    return (obj.namespace, obj.name)
+
+
+CREATE = "create"
+UPDATE = "update"
+DELETE = "delete"
+
+
+@dataclass(frozen=True)
+class Request:
+    """store/request.go:33-69."""
+
+    key: Key
+    type: str
+    retry_count: int = 0
+
+    def with_incremented_retry_count(self) -> "Request":
+        return Request(self.key, self.type, self.retry_count + 1)
+
+
+def create_request(obj: APIObject) -> Request:
+    return Request(key_of(obj), CREATE)
+
+
+def update_request(obj: APIObject) -> Request:
+    return Request(key_of(obj), UPDATE)
+
+
+def delete_request(key: Key) -> Request:
+    return Request(key, DELETE)
+
+
+class ObjectStore:
+    """Thread-safe map[(ns,name)] → object (store.go:27-130)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: Dict[Key, APIObject] = {}
+
+    def put(self, obj: APIObject) -> None:
+        """Store obj, preserving the currently-known resourceVersion: this
+        process is the sole writer, so local RV is authoritative
+        (store.go:51-59)."""
+        with self._lock:
+            key = key_of(obj)
+            current = self._store.get(key)
+            if current is not None:
+                obj.meta.resource_version = current.meta.resource_version
+            self._store[key] = obj
+
+    def override_resource_version_if_newer(self, obj: APIObject) -> bool:
+        """Fold an externally-observed object in: only bump our RV if the
+        external one is numerically newer (store.go:62-76)."""
+        with self._lock:
+            key = key_of(obj)
+            current = self._store.get(key)
+            if current is None:
+                self._store[key] = obj
+                return True
+            is_newer = current.meta.resource_version < obj.meta.resource_version
+            if is_newer:
+                current.meta.resource_version = obj.meta.resource_version
+            return is_newer
+
+    def put_if_absent(self, obj: APIObject) -> bool:
+        with self._lock:
+            key = key_of(obj)
+            if key in self._store:
+                return False
+            self._store[key] = obj
+            return True
+
+    def get(self, key: Key) -> Optional[APIObject]:
+        with self._lock:
+            return self._store.get(key)
+
+    def delete(self, key: Key) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def list(self) -> List[APIObject]:
+        with self._lock:
+            return list(self._store.values())
+
+
+def fnv32a(data: bytes) -> int:
+    """FNV-1a 32-bit (hash/fnv), used for shard affinity."""
+    h = 0x811C9DC5
+    for b in data:
+        h ^= b
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+# maximum queued requests per shard before producers block / TryAdd fails
+# (queue.go:22-27)
+ASYNC_REQUEST_BUFFER_SIZE = 100
+
+
+class ShardedUniqueQueue:
+    """queue.go:34-128.
+
+    Consumers receive zero-arg callables; invoking one releases the key's
+    inflight marker and returns the Request — the store holds the latest
+    object, the queue only records "there is a pending write".
+    """
+
+    def __init__(self, buckets: int, buffer_size: int = ASYNC_REQUEST_BUFFER_SIZE):
+        self._queues: List[_queue.Queue] = [_queue.Queue(maxsize=buffer_size) for _ in range(buckets)]
+        self._inflight: set[Key] = set()
+        self._lock = threading.Lock()
+
+    def add_if_absent(self, r: Request) -> None:
+        """Blocking enqueue; dedupes create/update, never deletes
+        (queue.go:63-68)."""
+        added = self._add_to_inflight_if_absent(r.key)
+        if added or r.type == DELETE:
+            self._get_queue(r).put(self._release_func(r))
+
+    def try_add_if_absent(self, r: Request) -> bool:
+        """Non-blocking; False only when the shard is full (queue.go:74-92)."""
+        added = self._add_to_inflight_if_absent(r.key)
+        if added or r.type == DELETE:
+            try:
+                self._get_queue(r).put_nowait(self._release_func(r))
+                return True
+            except _queue.Full:
+                if added:
+                    self._delete_from_inflight(r.key)
+                return False
+        return True
+
+    def get_consumers(self) -> List[_queue.Queue]:
+        return list(self._queues)
+
+    def queue_lengths(self) -> List[int]:
+        return [q.qsize() for q in self._queues]
+
+    def _get_queue(self, r: Request) -> _queue.Queue:
+        return self._queues[self._bucket(r.key)]
+
+    def _release_func(self, r: Request) -> Callable[[], Request]:
+        def release() -> Request:
+            self._delete_from_inflight(r.key)
+            return r
+
+        return release
+
+    def _bucket(self, key: Key) -> int:
+        return fnv32a(key[0].encode() + key[1].encode()) % len(self._queues)
+
+    def _add_to_inflight_if_absent(self, key: Key) -> bool:
+        with self._lock:
+            if key in self._inflight:
+                return False
+            self._inflight.add(key)
+            return True
+
+    def _delete_from_inflight(self, key: Key) -> None:
+        with self._lock:
+            self._inflight.discard(key)

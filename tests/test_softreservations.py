@@ -1,0 +1,91 @@
+"""Soft reservation store tests: tombstone race semantics
+(softreservations.go:41-50, 204-216)."""
+
+from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+from k8s_spark_scheduler_tpu.kube.informer import InformerFactory
+from k8s_spark_scheduler_tpu.scheduler.labels import (
+    SPARK_APP_ID_LABEL,
+    SPARK_ROLE_LABEL,
+    SPARK_SCHEDULER_NAME,
+)
+from k8s_spark_scheduler_tpu.state.softreservations import SoftReservationStore
+from k8s_spark_scheduler_tpu.types.objects import ObjectMeta, Pod, Reservation
+from k8s_spark_scheduler_tpu.types.resources import Resources
+
+
+def executor_pod(name, app="app-1"):
+    return Pod(
+        meta=ObjectMeta(
+            name=name,
+            labels={SPARK_APP_ID_LABEL: app, SPARK_ROLE_LABEL: "executor"},
+        ),
+        scheduler_name=SPARK_SCHEDULER_NAME,
+    )
+
+
+def res(node="n1"):
+    return Reservation.for_resources(node, Resources.of(1, "1Gi"))
+
+
+def test_add_and_usage():
+    s = SoftReservationStore()
+    s.create_soft_reservation_if_not_exists("app-1")
+    s.add_reservation_for_pod("app-1", "exec-1", res("n1"))
+    s.add_reservation_for_pod("app-1", "exec-2", res("n1"))
+    usage = s.used_soft_reservation_resources()
+    assert usage["n1"].eq(Resources.of(2, "2Gi"))
+    assert s.get_active_extra_executor_count() == 2
+    assert s.executor_has_soft_reservation(executor_pod("exec-1"))
+
+
+def test_tombstone_beats_schedule_race():
+    s = SoftReservationStore()
+    s.create_soft_reservation_if_not_exists("app-1")
+    s.add_reservation_for_pod("app-1", "exec-1", res())
+    # executor dies: reservation removed, tombstone left
+    s.remove_executor_reservation("app-1", "exec-1")
+    assert not s.executor_has_soft_reservation(executor_pod("exec-1"))
+    # a late schedule request for the same pod must NOT resurrect the spot
+    s.add_reservation_for_pod("app-1", "exec-1", res())
+    assert not s.executor_has_soft_reservation(executor_pod("exec-1"))
+    assert s.get_active_extra_executor_count() == 0
+
+
+def test_driver_death_removes_app():
+    s = SoftReservationStore()
+    s.create_soft_reservation_if_not_exists("app-1")
+    s.add_reservation_for_pod("app-1", "exec-1", res())
+    s.remove_driver_reservation("app-1")
+    _, ok = s.get_soft_reservation("app-1")
+    assert not ok
+    assert s.get_application_count() == 0
+
+
+def test_informer_pod_deletion_wiring():
+    api = APIServer()
+    factory = InformerFactory(api)
+    pod_informer = factory.informer("Pod")
+    pod_informer.start()
+    s = SoftReservationStore(pod_informer)
+    s.create_soft_reservation_if_not_exists("app-1")
+
+    api.create(executor_pod("exec-1"))
+    s.add_reservation_for_pod("app-1", "exec-1", res())
+    assert s.get_active_extra_executor_count() == 1
+    api.delete("Pod", "default", "exec-1")
+    assert s.get_active_extra_executor_count() == 0
+    # tombstoned
+    s.add_reservation_for_pod("app-1", "exec-1", res())
+    assert s.get_active_extra_executor_count() == 0
+
+    # driver deletion removes the whole app entry
+    driver = Pod(
+        meta=ObjectMeta(
+            name="drv", labels={SPARK_APP_ID_LABEL: "app-1", SPARK_ROLE_LABEL: "driver"}
+        ),
+        scheduler_name=SPARK_SCHEDULER_NAME,
+    )
+    api.create(driver)
+    api.delete("Pod", "default", "drv")
+    _, ok = s.get_soft_reservation("app-1")
+    assert not ok
