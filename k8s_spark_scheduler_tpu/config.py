@@ -1,0 +1,96 @@
+"""Install-time configuration (reference ``config/config.go:24-84``).
+
+The reference binds ``var/conf/install.yml`` into the Install struct; we
+accept the same shape from a dict / YAML-ish mapping (no YAML dependency:
+the server loads JSON or receives a dict directly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .ops.nodesort import LabelPriorityOrder
+from .scheduler.labels import DEFAULT_INSTANCE_GROUP_LABEL
+
+
+@dataclass
+class FifoConfig:
+    """config.go:58-64: enforce FIFO only after a driver is older than
+    this (seconds), per instance group."""
+
+    default_enforce_after_pod_age: float = 0.0
+    enforce_after_pod_age_by_instance_group: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class AsyncClientConfig:
+    """config.go:72-77."""
+
+    max_retry_count: int = 5
+
+
+@dataclass
+class Install:
+    """config.go:24-47."""
+
+    fifo: bool = False
+    fifo_config: FifoConfig = field(default_factory=FifoConfig)
+    qps: float = 0.0
+    burst: int = 0
+    binpack_algo: str = "distribute-evenly"
+    should_schedule_dynamically_allocated_executors_in_same_az: bool = False
+    instance_group_label: str = DEFAULT_INSTANCE_GROUP_LABEL
+    async_client: AsyncClientConfig = field(default_factory=AsyncClientConfig)
+    unschedulable_pod_timeout_seconds: float = 600.0
+    driver_prioritized_node_label: Optional[LabelPriorityOrder] = None
+    executor_prioritized_node_label: Optional[LabelPriorityOrder] = None
+    resource_reservation_crd_annotations: Dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Install":
+        fifo_cfg = d.get("fifo-config", {})
+        driver_label = d.get("driver-prioritized-node-label")
+        executor_label = d.get("executor-prioritized-node-label")
+        return Install(
+            fifo=d.get("fifo", False),
+            fifo_config=FifoConfig(
+                default_enforce_after_pod_age=fifo_cfg.get(
+                    "default-enforce-after-pod-age-seconds", 0.0
+                ),
+                enforce_after_pod_age_by_instance_group=fifo_cfg.get(
+                    "enforce-after-pod-age-by-instance-group", {}
+                ),
+            ),
+            qps=d.get("qps", 0.0),
+            burst=d.get("burst", 0),
+            binpack_algo=d.get("binpack", "distribute-evenly"),
+            should_schedule_dynamically_allocated_executors_in_same_az=d.get(
+                "should-schedule-dynamically-allocated-executors-in-same-az", False
+            ),
+            # back-compat default (cmd/server.go:67-71)
+            instance_group_label=d.get("instance-group-label", DEFAULT_INSTANCE_GROUP_LABEL),
+            async_client=AsyncClientConfig(
+                max_retry_count=d.get("async-client", {}).get("max-retry-count", 5)
+            ),
+            unschedulable_pod_timeout_seconds=d.get(
+                "unschedulable-pod-timeout-seconds", 600.0
+            ),
+            driver_prioritized_node_label=(
+                LabelPriorityOrder(
+                    driver_label["name"], driver_label["descending-priority-values"]
+                )
+                if driver_label
+                else None
+            ),
+            executor_prioritized_node_label=(
+                LabelPriorityOrder(
+                    executor_label["name"], executor_label["descending-priority-values"]
+                )
+                if executor_label
+                else None
+            ),
+            resource_reservation_crd_annotations=d.get(
+                "resource-reservation-crd-annotations", {}
+            ),
+        )

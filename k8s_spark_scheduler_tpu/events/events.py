@@ -1,0 +1,100 @@
+"""Structured event log (reference ``internal/events/events.go:28-82``).
+
+Three event types: application_scheduled, demand_created,
+demand_deleted.  Events are appended to a bounded in-memory ring (for
+tests/inspection) and emitted to the standard logger (the reference's
+evt2log analog).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+logger = logging.getLogger("k8s_spark_scheduler_tpu.events")
+
+APPLICATION_SCHEDULED = "foundry.spark.scheduler.application_scheduled"
+DEMAND_CREATED = "foundry.spark.scheduler.demand_created"
+DEMAND_DELETED = "foundry.spark.scheduler.demand_deleted"
+
+
+@dataclass
+class Event:
+    name: str
+    values: Dict[str, Any]
+    timestamp: float = field(default_factory=time.time)
+
+
+class EventLog:
+    def __init__(self, capacity: int = 4096):
+        self._events: deque[Event] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def emit(self, name: str, **values: Any) -> None:
+        event = Event(name, values)
+        with self._lock:
+            self._events.append(event)
+        logger.info("%s %s", name, values)
+
+    def all(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def by_name(self, name: str) -> List[Event]:
+        return [e for e in self.all() if e.name == name]
+
+
+# module-level default sink (swappable for tests)
+default_event_log = EventLog()
+
+
+def emit_application_scheduled(
+    instance_group: str,
+    spark_app_id: str,
+    pod_name: str,
+    pod_namespace: str,
+    driver_resources,
+    executor_resources,
+    min_executor_count: int,
+    max_executor_count: int,
+    event_log: EventLog | None = None,
+) -> None:
+    """events.go:34-58."""
+    (event_log or default_event_log).emit(
+        APPLICATION_SCHEDULED,
+        instanceGroup=instance_group,
+        sparkAppID=spark_app_id,
+        podName=pod_name,
+        podNamespace=pod_namespace,
+        driverCPU=driver_resources.cpu.serialize(),
+        driverMemory=driver_resources.memory.serialize(),
+        driverNvidiaGPUs=driver_resources.nvidia_gpu.serialize(),
+        executorCPU=executor_resources.cpu.serialize(),
+        executorMemory=executor_resources.memory.serialize(),
+        executorNvidiaGPUs=executor_resources.nvidia_gpu.serialize(),
+        minExecutorCount=min_executor_count,
+        maxExecutorCount=max_executor_count,
+    )
+
+
+def emit_demand_created(demand, event_log: EventLog | None = None) -> None:
+    (event_log or default_event_log).emit(
+        DEMAND_CREATED,
+        demandName=demand.name,
+        demandNamespace=demand.namespace,
+        instanceGroup=demand.spec.instance_group,
+    )
+
+
+def emit_demand_deleted(demand, source: str, event_log: EventLog | None = None) -> None:
+    (event_log or default_event_log).emit(
+        DEMAND_DELETED,
+        demandName=demand.name,
+        demandNamespace=demand.namespace,
+        instanceGroup=demand.spec.instance_group,
+        source=source,
+    )

@@ -115,6 +115,13 @@ class Informer:
                 self._update_handlers.append(wrap_update)
             if on_delete:
                 self._delete_handlers.append(wrap_delete)
+            snapshot = list(self._store.values()) if on_add else []
+        # client-go semantics: a late-registered handler receives synthetic
+        # ADD events for everything already in the store, so components
+        # wired after the informer started (overhead computer, stores) see
+        # pre-existing objects
+        for obj in snapshot:
+            wrap_add(obj)
 
     # -- lister interface ----------------------------------------------------
 

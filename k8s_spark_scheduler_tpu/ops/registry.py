@@ -7,6 +7,7 @@ Name → algorithm map with the reference's names plus the TPU-native
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 
 from . import packers
@@ -54,6 +55,12 @@ def select_binpacker(name: str) -> Binpacker:
 
             return tpu_batch_binpacker()
         except ImportError:
+            logging.getLogger(__name__).error(
+                "binpack 'tpu-batch' configured but the JAX batch solver could "
+                "not be imported; falling back to %s",
+                DEFAULT,
+                exc_info=True,
+            )
             return _REGISTRY[DEFAULT]
     return _REGISTRY.get(name, _REGISTRY[DEFAULT])
 

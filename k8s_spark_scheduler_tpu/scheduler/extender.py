@@ -1,0 +1,594 @@
+"""SparkSchedulerExtender: the gang-scheduling Filter implementation
+(reference ``internal/extender/resource.go``).
+
+Per-request flow: reconcile-if-idle → DA compaction → role dispatch.
+Drivers: idempotent replay, node-affinity filtering, availability
+snapshot, AZ-aware sort, FIFO earlier-drivers pass, gang binpack,
+demand create/delete, reservation creation.  Executors: bound-
+reservation replay, unbound rebinding, rescheduling with optional
+single-AZ confinement, soft-reservation consumption.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+from ..config import FifoConfig
+from ..demands.manager import DemandManager
+from ..events import events as ev
+from ..kube.informer import Informer
+from ..metrics.registry import MetricsRegistry, default_registry
+from ..ops import capacity as cap
+from ..ops.efficiency import compute_avg_packing_efficiency
+from ..ops.nodesort import NodeSorter
+from ..ops.registry import SINGLE_AZ_MINIMAL_FRAGMENTATION, Binpacker
+from ..types.extenderapi import ExtenderArgs, ExtenderFilterResult
+from ..types.objects import Node, Pod
+from ..types.resources import (
+    ZONE_LABEL,
+    available_for_nodes,
+    node_scheduling_metadata_for_nodes,
+    subtract_usage_if_exists,
+)
+from . import labels as L
+from .overhead import OverheadComputer
+from .reservations_manager import DRIVER_RESERVATION_NAME, ResourceReservationManager
+from .sparkpods import (
+    AnnotationError,
+    SparkPodLister,
+    spark_resource_usage,
+    spark_resources,
+)
+
+logger = logging.getLogger(__name__)
+
+# outcome constants (resource.go:46-60)
+FAILURE_UNBOUND = "failure-unbound"
+FAILURE_INTERNAL = "failure-internal"
+FAILURE_FIT = "failure-fit"
+FAILURE_EARLIER_DRIVER = "failure-earlier-driver"
+FAILURE_NON_SPARK_POD = "failure-non-spark-pod"
+SUCCESS = "success"
+SUCCESS_RESCHEDULED = "success-rescheduled"
+SUCCESS_ALREADY_BOUND = "success-already-bound"
+SUCCESS_SCHEDULED_EXTRA_EXECUTOR = "success-scheduled-extra-executor"
+
+SUCCESS_OUTCOMES = {
+    SUCCESS,
+    SUCCESS_ALREADY_BOUND,
+    SUCCESS_RESCHEDULED,
+    SUCCESS_SCHEDULED_EXTRA_EXECUTOR,
+}
+
+# reconciliation trigger: default LeaseDuration for core clients
+# (resource.go:57-59)
+LEADER_ELECTION_INTERVAL_SECONDS = 15.0
+
+
+class SchedulingFailure(Exception):
+    def __init__(self, outcome: str, message: str):
+        super().__init__(message)
+        self.outcome = outcome
+
+
+class SparkSchedulerExtender:
+    def __init__(
+        self,
+        node_informer: Informer,
+        pod_lister: SparkPodLister,
+        resource_reservation_cache,
+        soft_reservation_store,
+        resource_reservation_manager: ResourceReservationManager,
+        demands_manager: DemandManager,
+        is_fifo: bool,
+        fifo_config: FifoConfig,
+        binpacker: Binpacker,
+        should_schedule_dynamically_allocated_executors_in_same_az: bool,
+        overhead_computer: OverheadComputer,
+        instance_group_label: str,
+        node_sorter: NodeSorter,
+        metrics: MetricsRegistry | None = None,
+        event_log: Optional[ev.EventLog] = None,
+        waste_reporter=None,
+    ):
+        self._node_informer = node_informer
+        self._pod_lister = pod_lister
+        self._resource_reservations = resource_reservation_cache
+        self._soft_reservation_store = soft_reservation_store
+        self._rrm = resource_reservation_manager
+        self._demands = demands_manager
+        self._is_fifo = is_fifo
+        self._fifo_config = fifo_config
+        self.binpacker = binpacker
+        self._single_az_da = should_schedule_dynamically_allocated_executors_in_same_az
+        self._overhead = overhead_computer
+        self._instance_group_label = instance_group_label
+        self._node_sorter = node_sorter
+        self._metrics = metrics or default_registry
+        self._event_log = event_log
+        self._waste_reporter = waste_reporter
+        self._last_request = 0.0
+
+    # -- entry point ---------------------------------------------------------
+
+    def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
+        """resource.go:128-183."""
+        pod = args.pod
+        role = pod.labels.get(L.SPARK_ROLE_LABEL, "")
+        instance_group, ok = L.find_instance_group_from_pod_spec(pod, self._instance_group_label)
+        if not ok:
+            instance_group = ""
+
+        t0 = time.perf_counter()
+        try:
+            self._reconcile_if_needed()
+        except Exception as err:
+            logger.exception("failed to reconcile")
+            return self._fail_with_message(FAILURE_INTERNAL, args, "failed to reconcile")
+        self._rrm.compact_dynamic_allocation_applications()
+
+        try:
+            node_name, outcome = self._select_node(instance_group, role, pod, args.node_names)
+        except SchedulingFailure as err:
+            self._mark_schedule(instance_group, role, err.outcome, t0)
+            if err.outcome == FAILURE_INTERNAL:
+                logger.exception("internal error scheduling pod %s", pod.name)
+            else:
+                logger.info("failed to schedule pod %s: %s (%s)", pod.name, err, err.outcome)
+            return self._fail_with_message(err.outcome, args, str(err))
+
+        self._mark_schedule(instance_group, role, outcome, t0)
+
+        if role == L.DRIVER:
+            try:
+                app_resources = spark_resources(pod)
+            except AnnotationError as err:
+                logger.exception("internal error scheduling pod")
+                return self._fail_with_message(FAILURE_INTERNAL, args, str(err))
+            ev.emit_application_scheduled(
+                instance_group,
+                pod.labels.get(L.SPARK_APP_ID_LABEL, ""),
+                pod.name,
+                pod.namespace,
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+                app_resources.max_executor_count,
+                self._event_log,
+            )
+
+        logger.info("scheduling pod %s to node %s", pod.name, node_name)
+        return ExtenderFilterResult(node_names=[node_name])
+
+    def _mark_schedule(self, instance_group: str, role: str, outcome: str, t0: float) -> None:
+        self._metrics.histogram(
+            "foundry.spark.scheduler.schedule.time",
+            time.perf_counter() - t0,
+            {"instanceGroup": instance_group, "role": role, "outcome": outcome},
+        )
+        self._metrics.counter(
+            "foundry.spark.scheduler.schedule.outcome",
+            {"instanceGroup": instance_group, "role": role, "outcome": outcome},
+        )
+
+    def _fail_with_message(self, outcome: str, args: ExtenderArgs, message: str) -> ExtenderFilterResult:
+        if self._waste_reporter is not None:
+            self._waste_reporter.mark_failed_scheduling_attempt(args.pod, outcome)
+        return ExtenderFilterResult(failed_nodes={n: message for n in args.node_names})
+
+    def _reconcile_if_needed(self) -> None:
+        """resource.go:194-205."""
+        now = time.time()
+        if now > self._last_request + LEADER_ELECTION_INTERVAL_SECONDS:
+            from .failover import sync_resource_reservations_and_demands
+
+            sync_resource_reservations_and_demands(self)
+        self._last_request = now
+
+    def _select_node(
+        self, instance_group: str, role: str, pod: Pod, node_names: List[str]
+    ) -> Tuple[str, str]:
+        """resource.go:207-220."""
+        if role == L.DRIVER:
+            return self._select_driver_node(instance_group, pod, node_names)
+        if role == L.EXECUTOR:
+            node, outcome = self._select_executor_node(pod, node_names)
+            if outcome in SUCCESS_OUTCOMES:
+                self._demands.delete_demand_if_exists(pod, "SparkSchedulerExtender")
+            return node, outcome
+        raise SchedulingFailure(FAILURE_NON_SPARK_POD, "can not schedule non spark pod")
+
+    # -- driver path ---------------------------------------------------------
+
+    def _select_driver_node(
+        self, instance_group: str, driver: Pod, node_names: List[str]
+    ) -> Tuple[str, str]:
+        """resource.go:272-370."""
+        app_id = driver.labels.get(L.SPARK_APP_ID_LABEL, "")
+        rr = self._rrm.get_resource_reservation(app_id, driver.namespace)
+        if rr is not None:
+            # idempotent replay: return the previously reserved node
+            driver_reserved_node = rr.spec.reservations[DRIVER_RESERVATION_NAME].node
+            if driver_reserved_node not in node_names:
+                logger.warning(
+                    "driver already has a reservation but node %s is not in candidate list; "
+                    "returning it anyway",
+                    driver_reserved_node,
+                )
+            return driver_reserved_node, SUCCESS
+
+        available_nodes: List[Node] = self._node_informer.list_with_predicate(
+            lambda node: driver.matches_node(node)
+        )
+
+        usage = self._rrm.get_reserved_resources()
+        overhead = self._overhead.get_overhead(available_nodes)
+        metadata = node_scheduling_metadata_for_nodes(available_nodes, usage, overhead)
+        driver_node_names, executor_node_names = self._node_sorter.potential_nodes(
+            metadata, node_names
+        )
+        try:
+            app_resources = spark_resources(driver)
+        except AnnotationError as err:
+            raise SchedulingFailure(FAILURE_INTERNAL, f"failed to get spark resources: {err}")
+
+        if self._is_fifo:
+            queued_drivers = self._pod_lister.list_earlier_drivers(driver)
+            ok = self._fit_earlier_drivers(
+                instance_group, queued_drivers, driver_node_names, executor_node_names, metadata
+            )
+            if not ok:
+                self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
+                raise SchedulingFailure(
+                    FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
+                )
+
+        packing_result = self.binpacker.binpack_func(
+            app_resources.driver_resources,
+            app_resources.executor_resources,
+            app_resources.min_executor_count,
+            driver_node_names,
+            executor_node_names,
+            metadata,
+        )
+        if not packing_result.has_capacity:
+            self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
+            raise SchedulingFailure(FAILURE_FIT, "application does not fit to the cluster")
+
+        efficiency = compute_avg_packing_efficiency(
+            metadata, list(packing_result.packing_efficiencies.values())
+        )
+        self._metrics.gauge(
+            "foundry.spark.scheduler.packing.efficiency.max",
+            efficiency.max,
+            {"instanceGroup": instance_group, "binpacker": self.binpacker.name},
+        )
+        self._report_placement_metrics(instance_group, packing_result, available_nodes)
+
+        self._demands.delete_demand_if_exists(driver, "SparkSchedulerExtender")
+        self._rrm.create_reservations(
+            driver,
+            app_resources,
+            packing_result.driver_node,
+            packing_result.executor_nodes,
+        )
+        return packing_result.driver_node, SUCCESS
+
+    def _fit_earlier_drivers(
+        self,
+        instance_group: str,
+        drivers: List[Pod],
+        node_names: List[str],
+        executor_node_names: List[str],
+        metadata,
+    ) -> bool:
+        """resource.go:224-262: binpack every earlier driver and subtract
+        its usage before considering this one."""
+        for driver in drivers:
+            try:
+                app_resources = spark_resources(driver)
+            except AnnotationError:
+                logger.warning("failed to get driver resources, skipping driver %s", driver.name)
+                continue
+            packing_result = self.binpacker.binpack_func(
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+                node_names,
+                executor_node_names,
+                metadata,
+            )
+            if not packing_result.has_capacity:
+                if self._should_skip_driver_fifo(driver, instance_group):
+                    logger.debug(
+                        "skipping non-fitting driver %s from FIFO: not old enough", driver.name
+                    )
+                    continue
+                logger.warning("failed to fit earlier driver %s", driver.name)
+                return False
+            subtract_usage_if_exists(
+                metadata,
+                spark_resource_usage(
+                    app_resources.driver_resources,
+                    app_resources.executor_resources,
+                    packing_result.driver_node,
+                    packing_result.executor_nodes,
+                ),
+            )
+        return True
+
+    def _should_skip_driver_fifo(self, pod: Pod, instance_group: str) -> bool:
+        """resource.go:264-270."""
+        enforce_after = self._fifo_config.default_enforce_after_pod_age
+        enforce_after = self._fifo_config.enforce_after_pod_age_by_instance_group.get(
+            instance_group, enforce_after
+        )
+        return pod.creation_timestamp + enforce_after > time.time()
+
+    # -- executor path -------------------------------------------------------
+
+    def _select_executor_node(self, executor: Pod, node_names: List[str]) -> Tuple[str, str]:
+        """resource.go:383-435."""
+        try:
+            already_bound_node, found = self._rrm.find_already_bound_reservation_node(executor)
+        except KeyError as err:
+            raise SchedulingFailure(
+                FAILURE_INTERNAL, f"error when looking for already bound reservations: {err}"
+            )
+        if found:
+            result = self._reservation_node_from_node_list([already_bound_node], node_names)
+            if result is not None:
+                return result, SUCCESS_ALREADY_BOUND
+            logger.info(
+                "found already bound node %s for executor, but not in potential nodes",
+                already_bound_node,
+            )
+
+        try:
+            unbound_nodes, found_unbound = self._rrm.find_unbound_reservation_nodes(executor)
+        except KeyError as err:
+            raise SchedulingFailure(
+                FAILURE_INTERNAL, f"error when looking for unbound reservations: {err}"
+            )
+        if found_unbound:
+            result = self._reservation_node_from_node_list(unbound_nodes, node_names)
+            if result is not None:
+                try:
+                    self._rrm.reserve_for_executor_on_unbound_reservation(executor, result)
+                except Exception as err:
+                    raise SchedulingFailure(
+                        FAILURE_INTERNAL, f"failed to reserve node for executor: {err}"
+                    )
+                return result, SUCCESS
+
+        try:
+            free_spots = self._rrm.get_remaining_allowed_executor_count(
+                executor.labels.get(L.SPARK_APP_ID_LABEL, ""), executor.namespace
+            )
+        except KeyError as err:
+            raise SchedulingFailure(
+                FAILURE_INTERNAL, f"error when checking remaining allowed executors: {err}"
+            )
+        if free_spots > 0:
+            is_extra_executor = not found_unbound
+            node_name, outcome = self._reschedule_executor(executor, node_names, is_extra_executor)
+            try:
+                self._rrm.reserve_for_executor_on_rescheduled_node(executor, node_name)
+            except Exception as err:
+                raise SchedulingFailure(
+                    FAILURE_INTERNAL, f"failed to reserve node for rescheduled executor: {err}"
+                )
+            return node_name, outcome
+
+        raise SchedulingFailure(
+            FAILURE_UNBOUND, "application has no free executor spots to schedule this one"
+        )
+
+    @staticmethod
+    def _reservation_node_from_node_list(
+        reservation_nodes: List[str], node_names: List[str]
+    ) -> Optional[str]:
+        """resource.go:438-447."""
+        reservation_set = set(reservation_nodes)
+        for name in node_names:
+            if name in reservation_set:
+                return name
+        return None
+
+    def _get_nodes(self, node_names: List[str]) -> List[Node]:
+        nodes = []
+        for name in node_names:
+            node = self._node_informer.get("default", name)
+            if node is None:
+                logger.warning("failed to find node %s in cache, skipping", name)
+                continue
+            nodes.append(node)
+        return nodes
+
+    def _reschedule_executor(
+        self, executor: Pod, node_names: List[str], is_extra_executor: bool
+    ) -> Tuple[str, str]:
+        """resource.go:594-673."""
+        driver = self._pod_lister.get_driver_pod_for_executor(executor)
+        if driver is None:
+            raise SchedulingFailure(FAILURE_INTERNAL, "failed to get driver pod for executor")
+        try:
+            app_resources = spark_resources(driver)
+        except AnnotationError as err:
+            raise SchedulingFailure(FAILURE_INTERNAL, str(err))
+        executor_resources = app_resources.executor_resources
+        available_nodes = self._get_nodes(node_names)
+
+        should_schedule_into_single_az = False
+        single_az_zone = ""
+        if self.binpacker.is_single_az and self._single_az_da:
+            zone, all_in_same_az = self._get_common_zone_for_executors_application(executor)
+            if all_in_same_az:
+                available_nodes = self._filter_nodes_to_zone(available_nodes, zone)
+                node_names = [n.name for n in available_nodes]
+                single_az_zone = zone
+                should_schedule_into_single_az = True
+
+        usage = self._rrm.get_reserved_resources()
+        overhead = self._overhead.get_overhead(available_nodes)
+        metadata = node_scheduling_metadata_for_nodes(available_nodes, usage, overhead)
+
+        # QUIRK (reference resource.go:638-643 + resources.go:61-100): the
+        # Go NodeSchedulingMetadataForNodes mutates the caller's usage map
+        # in place (usage[node].Add(overhead) through a shared pointer) for
+        # nodes that have a usage entry, and the subsequent usage.Add(
+        # overhead) adds it AGAIN — so the first-fit reschedule path sees
+        # allocatable − reserved − 2×overhead on nodes with reservations,
+        # and allocatable − overhead on nodes without.  Replicated exactly
+        # for decision parity.
+        for node_name, node_overhead in overhead.items():
+            if node_name in usage:
+                usage[node_name] = usage[node_name].add(node_overhead).add(node_overhead)
+            else:
+                usage[node_name] = node_overhead
+        available_resources = available_for_nodes(available_nodes, usage)
+
+        _, executor_node_names = self._node_sorter.potential_nodes(metadata, node_names)
+
+        potential_outcome = (
+            SUCCESS_SCHEDULED_EXTRA_EXECUTOR if is_extra_executor else SUCCESS_RESCHEDULED
+        )
+
+        if self.binpacker.name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
+            name = self._reschedule_executor_with_minimal_fragmentation(
+                executor, executor_node_names, metadata, overhead, executor_resources
+            )
+            if name is not None:
+                return name, potential_outcome
+        else:
+            for name in executor_node_names:
+                if not executor_resources.greater_than(available_resources[name]):
+                    return name, potential_outcome
+
+        if should_schedule_into_single_az:
+            self._metrics.counter(
+                "foundry.spark.scheduler.single.az.dynamic.allocation.pack.failure",
+                {"zone": single_az_zone},
+            )
+            self._demands.create_demand_for_executor_in_specific_zone(
+                executor, executor_resources, single_az_zone
+            )
+        else:
+            self._demands.create_demand_for_executor_in_any_zone(executor, executor_resources)
+        raise SchedulingFailure(FAILURE_FIT, "not enough capacity to reschedule the executor")
+
+    def _reschedule_executor_with_minimal_fragmentation(
+        self,
+        executor: Pod,
+        executor_node_names: List[str],
+        metadata,
+        overhead,
+        executor_resources,
+    ) -> Optional[str]:
+        """resource.go:675-703: prefer nodes already hosting this app, then
+        least capacity."""
+        capacities = cap.get_node_capacities(
+            executor_node_names, metadata, overhead, executor_resources
+        )
+        app_nodes = self._get_nodes_with_executors_belonging_to_same_app(executor)
+
+        best: Optional[cap.NodeAndExecutorCapacity] = None
+        for node_capacity in capacities:
+            if node_capacity.capacity >= 1:
+                if best is None:
+                    best = node_capacity
+                elif node_capacity.node_name in app_nodes and best.node_name not in app_nodes:
+                    best = node_capacity
+                elif (node_capacity.node_name in app_nodes) == (best.node_name in app_nodes) and (
+                    node_capacity.capacity < best.capacity
+                ):
+                    best = node_capacity
+        return best.node_name if best is not None else None
+
+    def _get_nodes_with_executors_belonging_to_same_app(self, executor: Pod) -> set:
+        """resource.go:565-584."""
+        nodes = set()
+        app_id = executor.labels.get(L.SPARK_APP_ID_LABEL, "")
+        rr = self._rrm.get_resource_reservation(app_id, executor.namespace)
+        if rr is not None:
+            for pod, reservation in rr.spec.reservations.items():
+                if pod != DRIVER_RESERVATION_NAME:
+                    nodes.add(reservation.node)
+        sr, ok = self._rrm.get_soft_resource_reservation(app_id)
+        if ok:
+            for pod, reservation in sr.reservations.items():
+                if pod != DRIVER_RESERVATION_NAME:
+                    nodes.add(reservation.node)
+        return nodes
+
+    # -- single-AZ helpers ---------------------------------------------------
+
+    def _get_common_zone_for_executors_application(self, executor: Pod) -> Tuple[str, bool]:
+        """resource.go:493-515."""
+        app_id = executor.labels.get(L.SPARK_APP_ID_LABEL)
+        if app_id is None:
+            raise SchedulingFailure(FAILURE_INTERNAL, "executor has no spark app id label")
+        app_pods = self._pod_lister.list(
+            namespace=executor.namespace, label_selector={L.SPARK_APP_ID_LABEL: app_id}
+        )
+        from ..types.objects import PodPhase
+
+        running = [p for p in app_pods if p.phase == PodPhase.RUNNING]
+        zones = set()
+        for pod in running:
+            node = self._node_informer.get("default", pod.node_name)
+            if node is None:
+                raise SchedulingFailure(FAILURE_INTERNAL, f"node {pod.node_name} not found")
+            zone = node.labels.get(ZONE_LABEL)
+            if zone is None:
+                raise SchedulingFailure(
+                    FAILURE_INTERNAL, "could not read zone label from node"
+                )
+            zones.add(zone)
+        if len(zones) > 1:
+            return "", False
+        if len(zones) == 0:
+            raise SchedulingFailure(
+                FAILURE_INTERNAL,
+                "application has no scheduled pods, can't make scheduling decisions based on AZ",
+            )
+        return next(iter(zones)), True
+
+    def _filter_nodes_to_zone(self, nodes: List[Node], zone: str) -> List[Node]:
+        """resource.go:463-478."""
+        out = []
+        for node in nodes:
+            zone_label = node.labels.get(ZONE_LABEL)
+            if zone_label is None:
+                raise SchedulingFailure(
+                    FAILURE_INTERNAL, "could not read zone label from node"
+                )
+            if zone_label == zone:
+                out.append(node)
+        return out
+
+    # -- metrics -------------------------------------------------------------
+
+    def _report_placement_metrics(self, instance_group, packing_result, available_nodes) -> None:
+        executor_nodes = set(packing_result.executor_nodes)
+        self._metrics.gauge(
+            "foundry.spark.scheduler.driver.executor.collocation",
+            1.0 if packing_result.driver_node in executor_nodes else 0.0,
+            {"instanceGroup": instance_group},
+        )
+        self._metrics.gauge(
+            "foundry.spark.scheduler.executor.node.count",
+            float(len(executor_nodes)),
+            {"instanceGroup": instance_group},
+        )
+        zones = {}
+        for node in available_nodes:
+            zones[node.name] = node.labels.get(ZONE_LABEL, "")
+        used_zones = {zones.get(n, "") for n in executor_nodes | {packing_result.driver_node}}
+        self._metrics.gauge(
+            "foundry.spark.scheduler.app.cross.zone",
+            1.0 if len(used_zones) > 1 else 0.0,
+            {"instanceGroup": instance_group},
+        )

@@ -1,0 +1,174 @@
+"""Spark pod lister: FIFO queue view + annotation parsing
+(reference ``internal/extender/sparkpods.go``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..kube.informer import Informer
+from ..types.objects import Pod
+from ..types.resources import NodeGroupResources, Resources
+from ..utils.quantity import Quantity
+from . import labels as L
+
+
+@dataclass
+class SparkApplicationResources:
+    """internal/types SparkApplicationResources."""
+
+    driver_resources: Resources
+    executor_resources: Resources
+    min_executor_count: int
+    max_executor_count: int
+
+
+class AnnotationError(ValueError):
+    pass
+
+
+def spark_resources(pod: Pod) -> SparkApplicationResources:
+    """Parse the app's resource annotations (sparkpods.go:73-137).
+
+    Error cases mirror the reference: bad DA boolean, missing
+    executor-count without DA, missing DA min/max with DA, missing
+    driver/executor cpu/mem, unparseable quantity.
+    """
+    annotations = pod.annotations
+    da_raw = annotations.get(L.DYNAMIC_ALLOCATION_ENABLED)
+    dynamic_allocation_enabled = False
+    if da_raw is not None:
+        if da_raw.lower() in ("true", "1", "t"):
+            dynamic_allocation_enabled = True
+        elif da_raw.lower() in ("false", "0", "f"):
+            dynamic_allocation_enabled = False
+        else:
+            raise AnnotationError(
+                "annotation DynamicAllocationEnabled could not be parsed as a boolean"
+            )
+
+    parsed: Dict[str, Quantity] = {}
+    for key in (
+        L.DRIVER_CPU,
+        L.DRIVER_MEMORY,
+        L.DRIVER_NVIDIA_GPUS,
+        L.EXECUTOR_CPU,
+        L.EXECUTOR_MEMORY,
+        L.EXECUTOR_NVIDIA_GPUS,
+        L.EXECUTOR_COUNT,
+        L.DA_MIN_EXECUTOR_COUNT,
+        L.DA_MAX_EXECUTOR_COUNT,
+    ):
+        value = annotations.get(key)
+        if value is None:
+            if key in (L.DRIVER_NVIDIA_GPUS, L.EXECUTOR_NVIDIA_GPUS):
+                continue  # optional: GPUs not required
+            if not dynamic_allocation_enabled and key == L.EXECUTOR_COUNT:
+                raise AnnotationError(
+                    "annotation ExecutorCount is required when DynamicAllocationEnabled is false"
+                )
+            if dynamic_allocation_enabled and key in (
+                L.DA_MIN_EXECUTOR_COUNT,
+                L.DA_MAX_EXECUTOR_COUNT,
+            ):
+                raise AnnotationError(
+                    f"annotation {key} is required when DynamicAllocationEnabled is true"
+                )
+            if key in (L.EXECUTOR_COUNT, L.DA_MIN_EXECUTOR_COUNT, L.DA_MAX_EXECUTOR_COUNT):
+                continue  # not needed in this mode
+            raise AnnotationError(f"annotation {key} is missing from driver")
+        try:
+            parsed[key] = Quantity(value)
+        except ValueError:
+            raise AnnotationError(
+                f"annotation {key} does not have a parseable value {value}"
+            ) from None
+
+    if dynamic_allocation_enabled:
+        min_executor_count = parsed[L.DA_MIN_EXECUTOR_COUNT].value()
+        max_executor_count = parsed[L.DA_MAX_EXECUTOR_COUNT].value()
+    else:
+        min_executor_count = parsed[L.EXECUTOR_COUNT].value()
+        max_executor_count = min_executor_count
+
+    zero = Quantity(0)
+    return SparkApplicationResources(
+        driver_resources=Resources(
+            parsed[L.DRIVER_CPU], parsed[L.DRIVER_MEMORY], parsed.get(L.DRIVER_NVIDIA_GPUS, zero)
+        ),
+        executor_resources=Resources(
+            parsed[L.EXECUTOR_CPU],
+            parsed[L.EXECUTOR_MEMORY],
+            parsed.get(L.EXECUTOR_NVIDIA_GPUS, zero),
+        ),
+        min_executor_count=min_executor_count,
+        max_executor_count=max_executor_count,
+    )
+
+
+def spark_resource_usage(
+    driver_resources: Resources,
+    executor_resources: Resources,
+    driver_node: str,
+    executor_nodes: List[str],
+) -> NodeGroupResources:
+    """sparkpods.go:139-146.
+
+    QUIRK (reference behavior): per-node entries are *assigned*, not
+    accumulated — a node hosting N executors contributes one executor's
+    worth, and a driver node that also hosts executors is counted as
+    executors only.  The FIFO pass subtracts this, so preserving it is
+    required for decision parity.
+    """
+    usage: NodeGroupResources = {}
+    usage[driver_node] = driver_resources
+    for node in executor_nodes:
+        usage[node] = executor_resources
+    return usage
+
+
+class SparkPodLister:
+    """sparkpods.go:36-71 + driver lookups."""
+
+    def __init__(self, pod_informer: Informer, instance_group_label: str):
+        self._informer = pod_informer
+        self._instance_group_label = instance_group_label
+
+    @property
+    def informer(self) -> Informer:
+        return self._informer
+
+    def list(self, namespace: Optional[str] = None, label_selector=None) -> List[Pod]:
+        return self._informer.list(namespace=namespace, label_selector=label_selector)
+
+    def list_earlier_drivers(self, driver: Pod) -> List[Pod]:
+        """Unscheduled drivers in the same instance group, targeted at the
+        same scheduler, created strictly earlier, sorted by creation time
+        (sparkpods.go:45-71)."""
+        drivers = self._informer.list(label_selector={L.SPARK_ROLE_LABEL: L.DRIVER})
+        earlier = [
+            p
+            for p in drivers
+            if p.node_name == ""
+            and p.scheduler_name == driver.scheduler_name
+            and L.match_pod_instance_group(p, driver, self._instance_group_label)
+            and p.creation_timestamp < driver.creation_timestamp
+            and p.meta.deletion_timestamp is None
+        ]
+        earlier.sort(key=lambda p: p.creation_timestamp)
+        return earlier
+
+    def get_driver_pod_for_executor(self, executor: Pod) -> Optional[Pod]:
+        return self.get_driver_pod(
+            executor.labels.get(L.SPARK_APP_ID_LABEL, ""), executor.namespace
+        )
+
+    def get_driver_pod(self, app_id: str, namespace: str) -> Optional[Pod]:
+        """sparkpods.go:152-159 (exactly one match or None)."""
+        drivers = self._informer.list(
+            namespace=namespace,
+            label_selector={L.SPARK_APP_ID_LABEL: app_id, L.SPARK_ROLE_LABEL: L.DRIVER},
+        )
+        if len(drivers) != 1:
+            return None
+        return drivers[0]

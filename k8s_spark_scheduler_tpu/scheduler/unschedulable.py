@@ -1,0 +1,126 @@
+"""Unschedulable-pod marker (reference
+``internal/extender/unschedulablepods.go``).
+
+Periodically scans pending drivers older than the timeout and checks
+whether the gang could fit an *otherwise-empty* cluster (zero usage, but
+still subtracting non-schedulable overhead — daemonset pods etc.,
+unschedulablepods.go:149-151).  Sets/clears the
+``PodExceedsClusterCapacity`` pod condition.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..kube.apiserver import APIServer
+from ..kube.informer import Informer
+from ..ops.registry import Binpacker
+from ..types.objects import Pod, PodCondition
+from ..types.resources import Resources, node_scheduling_metadata_for_nodes
+from . import labels as L
+from .overhead import OverheadComputer
+from .sparkpods import AnnotationError, spark_resources
+
+logger = logging.getLogger(__name__)
+
+POD_EXCEEDS_CLUSTER_CAPACITY = "PodExceedsClusterCapacity"
+UNSCHEDULABLE_POLLING_INTERVAL_SECONDS = 60.0
+DEFAULT_TIMEOUT_SECONDS = 600.0
+
+
+class UnschedulablePodMarker:
+    def __init__(
+        self,
+        api: APIServer,
+        node_informer: Informer,
+        pod_informer: Informer,
+        overhead_computer: OverheadComputer,
+        binpacker: Binpacker,
+        timeout_seconds: float = DEFAULT_TIMEOUT_SECONDS,
+        polling_interval_seconds: float = UNSCHEDULABLE_POLLING_INTERVAL_SECONDS,
+    ):
+        if timeout_seconds <= 0:
+            timeout_seconds = DEFAULT_TIMEOUT_SECONDS
+        self._api = api
+        self._node_informer = node_informer
+        self._pod_informer = pod_informer
+        self._overhead = overhead_computer
+        self._binpacker = binpacker
+        self._timeout = timeout_seconds
+        self._interval = polling_interval_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="unschedulable-marker")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.scan_for_unschedulable_pods()
+            except Exception:
+                logger.exception("unschedulable pod scan failed")
+
+    def scan_for_unschedulable_pods(self) -> None:
+        """unschedulablepods.go:93-129."""
+        now = time.time()
+        for pod in self._pod_informer.list():
+            if (
+                pod.scheduler_name == L.SPARK_SCHEDULER_NAME
+                and pod.node_name == ""
+                and pod.meta.deletion_timestamp is None
+                and pod.labels.get(L.SPARK_ROLE_LABEL) == L.DRIVER
+                and pod.creation_timestamp + self._timeout < now
+            ):
+                try:
+                    exceeds = self.does_pod_exceed_cluster_capacity(pod)
+                except AnnotationError:
+                    logger.exception("failed to check if pod was unschedulable")
+                    return
+                if exceeds:
+                    logger.info("marking pod %s as exceeds capacity", pod.name)
+                self._mark_pod_cluster_capacity_status(pod, exceeds)
+
+    def does_pod_exceed_cluster_capacity(self, driver: Pod) -> bool:
+        """unschedulablepods.go:132-166: binpack against zero usage plus
+        non-schedulable overhead."""
+        nodes = self._node_informer.list_with_predicate(lambda n: driver.matches_node(n))
+        node_names = [n.name for n in nodes]
+        zero_usage = {n.name: Resources.zero() for n in nodes}
+        overhead = self._overhead.get_non_schedulable_overhead(nodes)
+        metadata = node_scheduling_metadata_for_nodes(nodes, zero_usage, overhead)
+        app_resources = spark_resources(driver)
+        result = self._binpacker.binpack_func(
+            app_resources.driver_resources,
+            app_resources.executor_resources,
+            app_resources.min_executor_count,
+            node_names,
+            node_names,
+            metadata,
+        )
+        return not result.has_capacity
+
+    def _mark_pod_cluster_capacity_status(self, driver: Pod, exceeds: bool) -> None:
+        """unschedulablepods.go:168-180 (condition update only when
+        changed)."""
+        status = "True" if exceeds else "False"
+        current = driver.conditions.get(POD_EXCEEDS_CLUSTER_CAPACITY)
+        if current is not None and current.status == status:
+            return
+        try:
+            fresh = self._api.get(Pod.KIND, driver.namespace, driver.name)
+            fresh.conditions[POD_EXCEEDS_CLUSTER_CAPACITY] = PodCondition(
+                type=POD_EXCEEDS_CLUSTER_CAPACITY, status=status, transition_time=time.time()
+            )
+            self._api.update(fresh)
+        except Exception:
+            # per-pod failure (e.g. pod deleted concurrently) must not
+            # abort the scan of the remaining drivers
+            logger.exception("failed to mark pod cluster capacity status")

@@ -1,0 +1,169 @@
+"""Server wiring: constructs every component bottom-up
+(reference ``cmd/server.go:65-237`` InitServerWithClients).
+
+Exported for tests and the HTTP server alike — the Harness builds on
+this exactly as the reference's extendertest harness builds on
+InitServerWithClients.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..config import Install
+from ..demands.manager import DemandManager
+from ..events.events import EventLog
+from ..kube import crd
+from ..kube.apiserver import APIServer
+from ..kube.informer import Informer, InformerFactory
+from ..metrics.registry import MetricsRegistry
+from ..ops.nodesort import NodeSorter
+from ..ops.registry import select_binpacker
+from ..scheduler.demand_gc import start_demand_gc
+from ..scheduler.extender import SparkSchedulerExtender
+from ..scheduler.overhead import OverheadComputer
+from ..scheduler.reservations_manager import ResourceReservationManager
+from ..scheduler.sparkpods import SparkPodLister
+from ..scheduler.unschedulable import UnschedulablePodMarker
+from ..state.softreservations import SoftReservationStore
+from ..state.typed_caches import (
+    LazyDemandInformer,
+    ResourceReservationCache,
+    SafeDemandCache,
+)
+from ..types.objects import Demand, Node, Pod, ResourceReservation
+
+
+@dataclass
+class Server:
+    """Everything InitServerWithClients wires up."""
+
+    api: APIServer
+    install: Install
+    informer_factory: InformerFactory
+    pod_informer: Informer
+    node_informer: Informer
+    rr_informer: Informer
+    resource_reservation_cache: ResourceReservationCache
+    lazy_demand_informer: LazyDemandInformer
+    demand_cache: SafeDemandCache
+    demand_manager: DemandManager
+    soft_reservation_store: SoftReservationStore
+    pod_lister: SparkPodLister
+    resource_reservation_manager: ResourceReservationManager
+    overhead_computer: OverheadComputer
+    extender: SparkSchedulerExtender
+    unschedulable_marker: UnschedulablePodMarker
+    metrics: MetricsRegistry
+    event_log: EventLog
+
+    def start_background(self) -> None:
+        """Start async writers + periodic loops (cmd/server.go:221-230)."""
+        self.resource_reservation_cache.run()
+        self.lazy_demand_informer.start()
+        self.unschedulable_marker.start()
+
+    def stop(self) -> None:
+        self.unschedulable_marker.stop()
+        self.resource_reservation_cache.stop()
+        self.demand_cache.stop()
+
+
+def init_server_with_clients(
+    api: APIServer,
+    install: Install,
+    start_background: bool = True,
+    demand_poll_interval: float = 1.0,
+    unschedulable_polling_interval: float = 60.0,
+) -> Server:
+    """cmd/server.go:65-237, bottom-up."""
+    metrics = MetricsRegistry()
+    event_log = EventLog()
+
+    # CRD ensure (cmd/server.go:83-85)
+    crd.ensure_resource_reservations_crd(api, install.resource_reservation_crd_annotations)
+
+    # informer factories + sync (cmd/server.go:91-127)
+    factory = InformerFactory(api)
+    pod_informer = factory.informer(Pod.KIND)
+    node_informer = factory.informer(Node.KIND)
+    rr_informer = factory.informer(ResourceReservation.KIND)
+    factory.start()
+
+    # caches (cmd/server.go:129-155)
+    rr_cache = ResourceReservationCache(
+        api, rr_informer, install.async_client.max_retry_count
+    )
+    lazy_demand_informer = LazyDemandInformer(api, factory, poll_interval=demand_poll_interval)
+    binpacker = select_binpacker(install.binpack_algo)
+    demand_cache = SafeDemandCache(
+        lazy_demand_informer, api, install.async_client.max_retry_count
+    )
+    demand_manager = DemandManager(
+        demand_cache, binpacker, install.instance_group_label, event_log
+    )
+    start_demand_gc(pod_informer, demand_manager)
+
+    # stores + managers (cmd/server.go:157-167)
+    soft_store = SoftReservationStore(pod_informer)
+    pod_lister = SparkPodLister(pod_informer, install.instance_group_label)
+    rrm = ResourceReservationManager(rr_cache, soft_store, pod_lister, pod_informer)
+    overhead = OverheadComputer(pod_informer, rrm)
+
+    # extender (cmd/server.go:171-191)
+    node_sorter = NodeSorter(
+        install.driver_prioritized_node_label, install.executor_prioritized_node_label
+    )
+    extender = SparkSchedulerExtender(
+        node_informer=node_informer,
+        pod_lister=pod_lister,
+        resource_reservation_cache=rr_cache,
+        soft_reservation_store=soft_store,
+        resource_reservation_manager=rrm,
+        demands_manager=demand_manager,
+        is_fifo=install.fifo,
+        fifo_config=install.fifo_config,
+        binpacker=binpacker,
+        should_schedule_dynamically_allocated_executors_in_same_az=(
+            install.should_schedule_dynamically_allocated_executors_in_same_az
+        ),
+        overhead_computer=overhead,
+        instance_group_label=install.instance_group_label,
+        node_sorter=node_sorter,
+        metrics=metrics,
+        event_log=event_log,
+    )
+    marker = UnschedulablePodMarker(
+        api,
+        node_informer,
+        pod_informer,
+        overhead,
+        binpacker,
+        timeout_seconds=install.unschedulable_pod_timeout_seconds,
+        polling_interval_seconds=unschedulable_polling_interval,
+    )
+
+    server = Server(
+        api=api,
+        install=install,
+        informer_factory=factory,
+        pod_informer=pod_informer,
+        node_informer=node_informer,
+        rr_informer=rr_informer,
+        resource_reservation_cache=rr_cache,
+        lazy_demand_informer=lazy_demand_informer,
+        demand_cache=demand_cache,
+        demand_manager=demand_manager,
+        soft_reservation_store=soft_store,
+        pod_lister=pod_lister,
+        resource_reservation_manager=rrm,
+        overhead_computer=overhead,
+        extender=extender,
+        unschedulable_marker=marker,
+        metrics=metrics,
+        event_log=event_log,
+    )
+    if start_background:
+        server.start_background()
+    return server

@@ -13,6 +13,7 @@ import time
 from typing import Callable, List, Optional
 
 from ..kube.apiserver import APIServer
+from ..kube.crd import DEMAND_CRD_NAME
 from ..kube.informer import Informer, InformerFactory
 from ..types.objects import Demand, ResourceReservation
 from .cache import AsyncClient, TypedClient, WriteBackCache
@@ -95,9 +96,6 @@ class DemandCache:
 
     def inflight_queue_lengths(self) -> List[int]:
         return self._queue.queue_lengths()
-
-
-DEMAND_CRD_NAME = "demands.scaler.palantir.com"
 
 
 class LazyDemandInformer:

@@ -1,0 +1,29 @@
+"""kube-scheduler extender protocol types
+(k8s.io/kube-scheduler/extender/v1, used at cmd/endpoints.go:25-41)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .objects import Pod
+
+
+@dataclass
+class ExtenderArgs:
+    pod: Pod
+    node_names: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ExtenderFilterResult:
+    node_names: Optional[List[str]] = None
+    failed_nodes: Dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "NodeNames": self.node_names,
+            "FailedNodes": self.failed_nodes or None,
+            "Error": self.error or None,
+        }

@@ -205,9 +205,6 @@ class ResourceReservationStatus:
     pods: Dict[str, str] = field(default_factory=dict)
 
 
-APP_ID_LABEL = "spark-app-id"
-
-
 @dataclass
 class ResourceReservation(APIObject):
     KIND = "ResourceReservation"

@@ -1,0 +1,291 @@
+"""Extender-level tests against the full wiring (reference
+internal/extender/resource_test.go scenarios re-derived on the Harness)."""
+
+import time
+
+import pytest
+
+from k8s_spark_scheduler_tpu.events.events import DEMAND_CREATED, DEMAND_DELETED
+from k8s_spark_scheduler_tpu.scheduler.labels import SPARK_APP_ID_LABEL
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.close()
+
+
+def two_node_cluster(h: Harness):
+    h.new_node("n1")
+    h.new_node("n2")
+    return ["n1", "n2"]
+
+
+# -- TestScheduler (resource_test.go:27) ------------------------------------
+
+
+def test_gang_schedule_happy_path(harness):
+    nodes = two_node_cluster(harness)
+    pods = harness.static_allocation_spark_pods("app-1", 2)
+    driver, execs = pods[0], pods[1:]
+
+    driver_node = harness.assert_success(harness.schedule(driver, nodes))
+    assert driver_node in nodes
+    rr = harness.get_resource_reservation("app-1")
+    assert rr is not None
+    assert len(rr.spec.reservations) == 3  # driver + 2 executors
+    assert rr.status.pods["driver"] == driver.name
+
+    for e in execs:
+        node = harness.assert_success(harness.schedule(e, nodes))
+        assert node in nodes
+    rr = harness.get_resource_reservation("app-1")
+    assert set(rr.status.pods.values()) == {driver.name, execs[0].name, execs[1].name}
+
+
+def test_extra_executor_rejected_when_all_bound(harness):
+    nodes = two_node_cluster(harness)
+    pods = harness.static_allocation_spark_pods("app-1", 1)
+    driver, exec1 = pods[0], pods[1]
+    harness.assert_success(harness.schedule(driver, nodes))
+    harness.assert_success(harness.schedule(exec1, nodes))
+
+    # a second executor beyond the reservation count must be rejected
+    extra = harness.static_allocation_spark_pods("app-1", 1)[1]
+    extra.meta.name = "app-1-exec-extra"
+    harness.assert_failure(harness.schedule(extra, nodes))
+
+
+def test_executor_rebind_after_death(harness):
+    nodes = two_node_cluster(harness)
+    pods = harness.static_allocation_spark_pods("app-1", 1)
+    driver, exec1 = pods[0], pods[1]
+    harness.assert_success(harness.schedule(driver, nodes))
+    bound_node = harness.assert_success(harness.schedule(exec1, nodes))
+
+    # executor dies; replacement takes over the dead executor's reservation
+    harness.terminate_pod(exec1)
+    replacement = harness.static_allocation_spark_pods("app-1", 1)[1]
+    replacement.meta.name = "app-1-exec-replacement"
+    node = harness.assert_success(harness.schedule(replacement, nodes))
+    assert node == bound_node
+    rr = harness.get_resource_reservation("app-1")
+    assert replacement.name in rr.status.pods.values()
+    assert exec1.name not in rr.status.pods.values()
+
+
+def test_idempotent_driver_replay(harness):
+    nodes = two_node_cluster(harness)
+    driver = harness.static_allocation_spark_pods("app-1", 1)[0]
+    first = harness.assert_success(harness.schedule(driver, nodes))
+    # replayed Filter call returns the reserved node again
+    replay = harness.extender.predicate(ExtenderArgs(pod=driver, node_names=list(nodes)))
+    assert replay.node_names == [first]
+
+
+def test_idempotent_executor_replay(harness):
+    nodes = two_node_cluster(harness)
+    pods = harness.static_allocation_spark_pods("app-1", 1)
+    harness.assert_success(harness.schedule(pods[0], nodes))
+    node = harness.assert_success(harness.schedule(pods[1], nodes))
+    replay = harness.extender.predicate(ExtenderArgs(pod=pods[1], node_names=list(nodes)))
+    assert replay.node_names == [node]
+
+
+def test_gang_reject_when_cluster_too_small(harness):
+    two_node_cluster(harness)
+    driver = harness.static_allocation_spark_pods("app-big", 32)[0]
+    result = harness.schedule(driver, ["n1", "n2"])
+    harness.assert_failure(result)
+    # a demand was created for the whole application
+    assert harness.wait_for_api(
+        lambda: harness.api.list("Demand") and True or False
+    )
+    demands = harness.api.list("Demand")
+    assert len(demands) == 1
+    assert demands[0].name == f"demand-{driver.name}"
+    units = demands[0].spec.units
+    assert units[0].count == 1 and units[1].count == 32
+
+
+def test_demand_deleted_after_success(harness):
+    two_node_cluster(harness)
+    driver = harness.static_allocation_spark_pods("app-1", 32)[0]
+    harness.assert_failure(harness.schedule(driver, ["n1", "n2"]))
+    assert harness.wait_for_api(lambda: len(harness.api.list("Demand")) == 1)
+
+    # capacity arrives
+    harness.new_node("n3", cpu="64", memory="64Gi")
+    harness.assert_success(harness.schedule(driver, ["n1", "n2", "n3"]))
+    assert harness.wait_for_api(lambda: len(harness.api.list("Demand")) == 0)
+    assert harness.server.event_log.by_name(DEMAND_CREATED)
+    assert harness.server.event_log.by_name(DEMAND_DELETED)
+
+
+def test_non_spark_pod_rejected(harness):
+    from k8s_spark_scheduler_tpu.types.objects import ObjectMeta, Pod
+
+    two_node_cluster(harness)
+    pod = Pod(meta=ObjectMeta(name="random"), scheduler_name="spark-scheduler")
+    result = harness.schedule(pod, ["n1", "n2"])
+    harness.assert_failure(result)
+
+
+# -- TestMinimalFragmentation (resource_test.go:73) -------------------------
+
+
+def test_minimal_fragmentation_attracts_to_app_nodes():
+    h = Harness(binpack_algo="single-az-minimal-fragmentation")
+    try:
+        h.new_node("n1", cpu="8", memory="8Gi")
+        h.new_node("n2", cpu="8", memory="8Gi")
+        nodes = ["n1", "n2"]
+        pods = h.dynamic_allocation_spark_pods("app-1", 1, 3)
+        driver, execs = pods[0], pods[1:]
+        h.assert_success(h.schedule(driver, nodes))
+        first_node = h.assert_success(h.schedule(execs[0], nodes))
+        # extra executors prefer the node already hosting the app
+        second_node = h.assert_success(h.schedule(execs[1], nodes))
+        assert second_node == first_node
+    finally:
+        h.close()
+
+
+# -- TestDynamicAllocationScheduling (resource_test.go:172) -----------------
+
+
+def test_dynamic_allocation_min_hard_max_soft(harness):
+    nodes = two_node_cluster(harness)
+    pods = harness.dynamic_allocation_spark_pods("app-da", 1, 3)
+    driver, execs = pods[0], pods[1:]
+
+    harness.assert_success(harness.schedule(driver, nodes))
+    rr = harness.get_resource_reservation("app-da")
+    # only min executors get hard reservations
+    assert len(rr.spec.reservations) == 2  # driver + 1
+
+    # first executor binds the hard reservation
+    harness.assert_success(harness.schedule(execs[0], nodes))
+    sr, ok = harness.server.soft_reservation_store.get_soft_reservation("app-da")
+    assert ok and len(sr.reservations) == 0
+
+    # extras get soft reservations up to max - min = 2
+    harness.assert_success(harness.schedule(execs[1], nodes))
+    harness.assert_success(harness.schedule(execs[2], nodes))
+    sr, _ = harness.server.soft_reservation_store.get_soft_reservation("app-da")
+    assert set(sr.reservations) == {execs[1].name, execs[2].name}
+
+    # a fourth executor exceeds max
+    extra = harness.dynamic_allocation_spark_pods("app-da", 1, 3)[1]
+    extra.meta.name = "app-da-exec-4"
+    harness.assert_failure(harness.schedule(extra, nodes))
+
+
+def test_dynamic_allocation_compaction_on_executor_death(harness):
+    nodes = two_node_cluster(harness)
+    pods = harness.dynamic_allocation_spark_pods("app-da", 1, 2)
+    driver, execs = pods[0], pods[1:]
+    harness.assert_success(harness.schedule(driver, nodes))
+    harness.assert_success(harness.schedule(execs[0], nodes))  # hard
+    harness.assert_success(harness.schedule(execs[1], nodes))  # soft
+
+    # the hard-reserved executor dies → its RR spot frees; deleting it
+    # queues the app for compaction
+    harness.delete_pod(execs[0])
+    # next predicate call triggers compaction: the soft executor moves to
+    # the hard reservation
+    probe = harness.static_allocation_spark_pods("probe", 0)[0]
+    harness.schedule(probe, nodes)
+
+    rr = harness.get_resource_reservation("app-da")
+    assert execs[1].name in rr.status.pods.values()
+    sr, _ = harness.server.soft_reservation_store.get_soft_reservation("app-da")
+    assert execs[1].name not in sr.reservations
+
+
+# -- FIFO (resource.go:309-319) ---------------------------------------------
+
+
+def test_fifo_blocks_later_driver(harness):
+    two_node_cluster(harness)
+    t0 = time.time()
+    # app-old needs more than the cluster has; app-new would fit
+    old_driver = harness.static_allocation_spark_pods(
+        "app-old", 32, creation_timestamp=t0 - 100
+    )[0]
+    new_driver = harness.static_allocation_spark_pods(
+        "app-new", 1, creation_timestamp=t0
+    )[0]
+    harness.create_pod(old_driver)
+    harness.assert_failure(harness.schedule(new_driver, ["n1", "n2"]))
+
+
+def test_fifo_enforce_after_pod_age_skips_young_drivers():
+    from k8s_spark_scheduler_tpu.config import FifoConfig
+
+    h = Harness(fifo_config=FifoConfig(default_enforce_after_pod_age=3600.0))
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        t0 = time.time()
+        old_driver = h.static_allocation_spark_pods("app-old", 32, creation_timestamp=t0 - 100)[0]
+        new_driver = h.static_allocation_spark_pods("app-new", 1, creation_timestamp=t0)[0]
+        h.create_pod(old_driver)
+        # old driver is younger than enforce-after → skipped from FIFO
+        h.assert_success(h.schedule(new_driver, ["n1", "n2"]))
+    finally:
+        h.close()
+
+
+def test_fifo_accounts_earlier_driver_usage(harness):
+    # earlier driver fits and its usage must be subtracted before packing
+    # the later driver: both fit only if accounting is correct
+    two_node_cluster(harness)
+    t0 = time.time()
+    first = harness.static_allocation_spark_pods("app-a", 6, creation_timestamp=t0 - 100)[0]
+    second = harness.static_allocation_spark_pods("app-b", 6, creation_timestamp=t0)[0]
+    harness.create_pod(first)
+    # cluster: 16 cpu total; app-a takes 7 (1 driver + 6); app-b takes 7;
+    # fits → but the FIFO subtraction QUIRK (one executor per node) means
+    # app-b sees more capacity than truly free; the final pack for app-b
+    # still must succeed here
+    harness.assert_success(harness.schedule(second, ["n1", "n2"]))
+
+
+# -- unschedulable marker (unschedulablepods_test.go) -----------------------
+
+
+def test_unschedulable_marker_flags_oversized_driver(harness):
+    two_node_cluster(harness)
+    driver = harness.static_allocation_spark_pods("app-huge", 100)[0]
+    driver.meta.creation_timestamp = time.time() - 3600
+    created = harness.create_pod(driver)
+    harness.unschedulable_marker.scan_for_unschedulable_pods()
+    fresh = harness.api.get("Pod", "default", driver.name)
+    cond = fresh.conditions.get("PodExceedsClusterCapacity")
+    assert cond is not None and cond.status == "True"
+
+
+def test_unschedulable_marker_gpu_exhaustion(harness):
+    # nodes have 1 GPU each; an 8-GPU executor ask can never fit
+    two_node_cluster(harness)
+    driver = harness.static_allocation_spark_pods(
+        "app-gpu", 1, executor_gpu="8"
+    )[0]
+    driver.meta.creation_timestamp = time.time() - 3600
+    harness.create_pod(driver)
+    assert harness.unschedulable_marker.does_pod_exceed_cluster_capacity(driver)
+
+
+def test_unschedulable_marker_clears_when_fits(harness):
+    two_node_cluster(harness)
+    driver = harness.static_allocation_spark_pods("app-ok", 1)[0]
+    driver.meta.creation_timestamp = time.time() - 3600
+    harness.create_pod(driver)
+    harness.unschedulable_marker.scan_for_unschedulable_pods()
+    fresh = harness.api.get("Pod", "default", driver.name)
+    cond = fresh.conditions.get("PodExceedsClusterCapacity")
+    assert cond is not None and cond.status == "False"
