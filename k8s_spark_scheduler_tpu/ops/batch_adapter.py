@@ -1,0 +1,233 @@
+"""Bridge between the scheduler's SparkBinPackFunction interface and the
+JAX batch solver: marshals snapshots to tensors, runs the jitted kernel,
+and decodes device results into the reference's exact placement lists.
+
+Safety net: any problem that can't be represented exactly in scaled
+int32 (tensorize.scale_problem.ok == False) falls back to the host
+oracle, so `binpack: tpu-batch` can never produce a wrong decision from
+numeric representation.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..types.resources import NodeGroupSchedulingMetadata, Resources
+from . import packers
+from .efficiency import compute_packing_efficiencies
+from .packers import PackingResult, empty_packing_result
+from .registry import Binpacker, TPU_BATCH
+from .tensorize import (
+    ClusterTensor,
+    ScaledProblem,
+    scale_problem,
+    tensorize_apps,
+    tensorize_cluster,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def evenly_counts(cap: np.ndarray, k: int) -> np.ndarray:
+    """Exact distribute-evenly per-node counts from per-node capacities
+    (distribute_evenly.go:34-73): t complete round-robin sweeps plus a
+    partial sweep over the first r capacity-remaining nodes in priority
+    order."""
+    cap = cap.astype(np.int64)
+    if k <= 0:
+        return np.zeros_like(cap)
+    total = int(cap.sum())
+    assert total >= k, "evenly_counts called on infeasible problem"
+
+    # S(t) = Σ min(cap, t) is monotone; find t_full = max{t : S(t) ≤ k}
+    lo, hi = 0, int(cap.max())
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if int(np.minimum(cap, mid).sum()) <= k:
+            lo = mid
+        else:
+            hi = mid - 1
+    t_full = lo
+    counts = np.minimum(cap, t_full)
+    r = k - int(counts.sum())
+    if r > 0:
+        open_nodes = np.flatnonzero(cap > t_full)[:r]
+        counts[open_nodes] += 1
+    return counts
+
+
+def counts_to_tightly_list(names: List[str], counts: np.ndarray) -> List[str]:
+    out: List[str] = []
+    for name, c in zip(names, counts):
+        if c > 0:
+            out.extend([name] * int(c))
+    return out
+
+
+def counts_to_evenly_list(names: List[str], counts: np.ndarray) -> List[str]:
+    """Round-robin visit order: sweep t emits every node with count > t,
+    in priority order (matches the Go loop's append order)."""
+    counts = counts.astype(np.int64)
+    k = int(counts.sum())
+    if k == 0:
+        return []
+    idx = np.flatnonzero(counts)
+    # (sweep, priority position) pairs for each emitted executor
+    sweeps = np.concatenate([np.arange(counts[i]) for i in idx])
+    positions = np.repeat(idx, counts[idx])
+    order = np.lexsort((positions, sweeps))
+    return [names[positions[j]] for j in order]
+
+
+class TpuBatchBinpacker:
+    """A drop-in SparkBinPackFunction backed by the JAX solver.
+
+    assignment_policy: 'tightly-pack' or 'distribute-evenly' — controls
+    the executor placement list (feasibility and driver choice are
+    policy-invariant, see batch_solver docstring).
+    """
+
+    def __init__(self, assignment_policy: str = "tightly-pack", verify_against_oracle: bool = False):
+        self.assignment_policy = assignment_policy
+        self.verify_against_oracle = verify_against_oracle
+
+    def __call__(
+        self,
+        driver_resources: Resources,
+        executor_resources: Resources,
+        executor_count: int,
+        driver_node_priority_order: Sequence[str],
+        executor_node_priority_order: Sequence[str],
+        metadata: NodeGroupSchedulingMetadata,
+    ) -> PackingResult:
+        from .sparkapp import app_resources_of  # lazy tiny helper
+
+        cluster = tensorize_cluster(
+            metadata, driver_node_priority_order, executor_node_priority_order
+        )
+        apps = tensorize_apps(
+            [app_resources_of(driver_resources, executor_resources, executor_count)]
+        )
+        problem = scale_problem(cluster, apps)
+        oracle = (
+            packers.tightly_pack
+            if self.assignment_policy == "tightly-pack"
+            else packers.distribute_evenly
+        )
+        if not problem.ok:
+            logger.warning("snapshot not exactly tensorizable; using host oracle")
+            return oracle(
+                driver_resources,
+                executor_resources,
+                executor_count,
+                driver_node_priority_order,
+                executor_node_priority_order,
+                metadata,
+            )
+
+        result = self._solve_and_decode(cluster, problem, executor_count, metadata)
+
+        if self.verify_against_oracle:
+            expected = oracle(
+                driver_resources,
+                executor_resources,
+                executor_count,
+                driver_node_priority_order,
+                executor_node_priority_order,
+                metadata,
+            )
+            if (
+                expected.has_capacity != result.has_capacity
+                or expected.driver_node != result.driver_node
+                or expected.executor_nodes != result.executor_nodes
+            ):
+                logger.error(
+                    "tpu-batch solver disagreed with oracle (solver %s@%s vs oracle %s@%s); "
+                    "using oracle",
+                    result.has_capacity,
+                    result.driver_node,
+                    expected.has_capacity,
+                    expected.driver_node,
+                )
+                return expected
+        return result
+
+    def _solve_and_decode(
+        self,
+        cluster: ClusterTensor,
+        problem: ScaledProblem,
+        executor_count: int,
+        metadata: NodeGroupSchedulingMetadata,
+    ) -> PackingResult:
+        import jax.numpy as jnp
+
+        from .batch_solver import solve_single
+
+        solve = solve_single(
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(problem.driver[0]),
+            jnp.asarray(problem.executor[0]),
+            jnp.asarray(problem.count[0]),
+        )
+        feasible = bool(solve.feasible)
+        if not feasible:
+            return empty_packing_result()
+
+        driver_idx = int(solve.driver_idx)
+        names = cluster.node_names
+        driver_node = names[driver_idx]
+
+        if self.assignment_policy == "tightly-pack":
+            counts = np.asarray(solve.exec_counts)[: len(names)]
+            executor_nodes = counts_to_tightly_list(names, counts)
+        else:
+            cap = np.asarray(solve.exec_capacity)[: len(names)]
+            counts = evenly_counts(cap, executor_count)
+            executor_nodes = counts_to_evenly_list(names, counts)
+
+        # efficiencies as the reference computes them: driver + per-node
+        # executor reservations folded into `reserved`
+        reserved = {driver_node: Resources.zero()}
+        # build reserved the same way the oracle mutates it
+        dr = metadata[driver_node]  # noqa: F841 (existence check)
+        reserved[driver_node] = self._scale_back(problem, problem.driver[0])
+        for name, c in zip(names, counts):
+            if c > 0:
+                add = self._scale_back(problem, problem.executor[0] * int(c))
+                reserved[name] = reserved.get(name, Resources.zero()).add(add)
+        return PackingResult(
+            driver_node=driver_node,
+            executor_nodes=executor_nodes,
+            has_capacity=True,
+            packing_efficiencies=compute_packing_efficiencies(metadata, reserved),
+        )
+
+    @staticmethod
+    def _scale_back(problem: ScaledProblem, row: np.ndarray) -> Resources:
+        from fractions import Fraction
+
+        from ..utils.quantity import Quantity
+
+        cpu_m, mem_b, gpu_m = (
+            int(row[0]) * int(problem.scale[0]),
+            int(row[1]) * int(problem.scale[1]),
+            int(row[2]) * int(problem.scale[2]),
+        )
+        return Resources(
+            Quantity(Fraction(cpu_m, 1000)),
+            Quantity(mem_b),
+            Quantity(Fraction(gpu_m, 1000)),
+        )
+
+
+def tpu_batch_binpacker() -> Binpacker:
+    return Binpacker(
+        name=TPU_BATCH,
+        binpack_func=TpuBatchBinpacker(assignment_policy="tightly-pack"),
+        is_single_az=False,
+    )

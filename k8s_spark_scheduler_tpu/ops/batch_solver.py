@@ -1,0 +1,226 @@
+"""JAX batch gang-packing solver — the TPU-native replacement for the
+reference's first-fit loops (SURVEY §3.2 hot loops; BASELINE.json north
+star).
+
+The key identity making the O(driver-candidates × nodes) Go loop an
+O(nodes) vector program: for the tightly-pack / distribute-evenly
+policies, executor distribution over a candidate set succeeds iff the
+total per-node executor capacity is ≥ k (both fill every node to its
+capacity in the limit), and placing the driver on node d only changes
+node d's capacity.  So
+
+    T_d = S − cap_d + cap'_d          (S = Σ min(cap_n, k))
+
+for every driver candidate d at once, and the chosen driver is the
+first-priority d with (driver fits d) ∧ (T_d ≥ k) — bit-identical to
+``SparkBinPack`` + ``tightlyPackExecutors`` / ``distributeExecutorsEvenly``
+(reference lib/pkg/binpack/binpack.go:60-87, pack_tightly.go:34-63,
+distribute_evenly.go:34-73), proven by the parity suite in
+tests/test_batch_parity.py.
+
+The FIFO earlier-drivers pass (resource.go:224-262) is a ``lax.scan``
+over apps carrying availability, reproducing the reference's
+usage-subtraction quirk (one executor's worth per hosting node,
+driver overwritten — sparkpods.go:139-146).
+
+All arrays are int32 (see tensorize.scale_problem for the exactness
+guarantee); everything here is shape-static and jit/vmap/shard_map
+compatible, with the node axis shardable over a device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BIG = jnp.int32(2**31 - 1)
+
+
+class AppSolve(NamedTuple):
+    """Per-app gang decision."""
+
+    feasible: jnp.ndarray      # [] bool
+    driver_idx: jnp.ndarray    # [] int32 (index into node axis; N if infeasible)
+    exec_counts: jnp.ndarray   # [N] int32 tightly-pack fill counts
+    exec_capacity: jnp.ndarray  # [N] int32 per-node capacity after driver placement
+
+
+def node_capacity(avail: jnp.ndarray, executor: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Per-node executor capacity clamped to [0, k]
+    (capacity.go:36-75: floor division per dim, zero-requirement → ∞)."""
+    safe = jnp.maximum(executor, 1)
+    per_dim = jnp.where(
+        executor[None, :] == 0,
+        BIG,
+        jnp.floor_divide(avail, safe[None, :]),
+    )
+    cap = jnp.min(per_dim, axis=1)
+    return jnp.clip(cap, 0, k)
+
+
+def solve_app(
+    avail: jnp.ndarray,        # [N, 3] int32
+    driver_rank: jnp.ndarray,  # [N] int32 — driver priority position, BIG if not a candidate
+    exec_ok: jnp.ndarray,      # [N] bool — in executor priority list (array order = that list)
+    driver: jnp.ndarray,       # [3] int32
+    executor: jnp.ndarray,     # [3] int32
+    k: jnp.ndarray,            # [] int32
+) -> AppSolve:
+    """One gang decision, O(N) vector ops."""
+    n = avail.shape[0]
+
+    # driver fit mask (Resources.GreaterThan: any-dim; fits = all dims ≤)
+    driver_fits = jnp.all(avail >= driver[None, :], axis=1) & (driver_rank < BIG)
+
+    # capacities without / with the driver on the node
+    base_cap = jnp.where(exec_ok, node_capacity(avail, executor, k), 0)
+    cap_with_driver = jnp.where(
+        exec_ok, node_capacity(avail - driver[None, :], executor, k), 0
+    )
+
+    total = jnp.sum(base_cap)
+    # total capacity if driver lands on d (only node d's capacity changes)
+    total_d = total - base_cap + cap_with_driver
+
+    feasible_d = driver_fits & (total_d >= k)
+    # first feasible node in DRIVER priority order (ranks are unique)
+    masked_rank = jnp.where(feasible_d, driver_rank, BIG)
+    driver_idx = jnp.argmin(masked_rank).astype(jnp.int32)
+    feasible = masked_rank[driver_idx] < BIG
+    driver_idx = jnp.where(feasible, driver_idx, jnp.int32(n))
+
+    safe_idx = jnp.minimum(driver_idx, n - 1)
+    cap = jnp.where(
+        jnp.arange(n, dtype=jnp.int32) == safe_idx, cap_with_driver, base_cap
+    )
+    cap = jnp.where(feasible, cap, jnp.zeros_like(cap))
+
+    # tightly-pack greedy fill: x_n = clip(k − Σ_{m<n} cap_m, 0, cap_n)
+    cum_excl = jnp.cumsum(cap) - cap
+    exec_counts = jnp.clip(k - cum_excl, 0, cap)
+    exec_counts = jnp.where(feasible, exec_counts, jnp.zeros_like(exec_counts))
+
+    return AppSolve(
+        feasible=feasible,
+        driver_idx=jnp.where(feasible, driver_idx, jnp.int32(n)),
+        exec_counts=exec_counts,
+        exec_capacity=cap,
+    )
+
+
+def evenly_exec_mask(cap: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Which nodes receive ≥1 executor under distribute-evenly: the first
+    min(k, #nodes-with-capacity) capacity-bearing nodes in priority order
+    (sweep 0 of the round-robin)."""
+    has = (cap > 0).astype(jnp.int32)
+    rank_excl = jnp.cumsum(has) - has
+    return (cap > 0) & (rank_excl < k)
+
+
+def usage_delta(
+    solve: AppSolve,
+    driver: jnp.ndarray,
+    executor: jnp.ndarray,
+    n: int,
+    evenly: bool,
+) -> jnp.ndarray:
+    """The reference's post-placement subtraction QUIRK
+    (sparkpods.go:139-146 + resources.go:129-135): nodes hosting ≥1
+    executor lose ONE executor's worth; the driver node loses the driver —
+    unless it also hosts executors, in which case the executor entry
+    overwrites the driver's."""
+    if evenly:
+        exec_mask = evenly_exec_mask(solve.exec_capacity, jnp.sum(solve.exec_counts))
+        exec_mask = exec_mask & solve.feasible
+    else:
+        exec_mask = solve.exec_counts > 0
+    is_driver = jnp.arange(n, dtype=jnp.int32) == solve.driver_idx
+    delta = jnp.where(
+        exec_mask[:, None],
+        executor[None, :],
+        jnp.where(is_driver[:, None], driver[None, :], jnp.zeros_like(driver)[None, :]),
+    )
+    return jnp.where(solve.feasible, delta, jnp.zeros_like(delta))
+
+
+class QueueSolve(NamedTuple):
+    feasible: jnp.ndarray     # [A] bool
+    driver_idx: jnp.ndarray   # [A] int32
+    exec_counts: jnp.ndarray  # [A, N] int32 (tightly-pack counts)
+    exec_capacity: jnp.ndarray  # [A, N] int32
+    avail_after: jnp.ndarray  # [N, 3] int32
+
+
+@functools.partial(jax.jit, static_argnames=("evenly", "with_placements"))
+def solve_queue(
+    avail: jnp.ndarray,      # [N, 3] int32
+    driver_rank: jnp.ndarray,  # [N] int32
+    exec_ok: jnp.ndarray,    # [N]
+    drivers: jnp.ndarray,    # [A, 3] int32
+    executors: jnp.ndarray,  # [A, 3] int32
+    counts: jnp.ndarray,     # [A] int32
+    app_valid: jnp.ndarray,  # [A] bool
+    evenly: bool = False,
+    with_placements: bool = True,
+) -> QueueSolve:
+    """Whole-FIFO-queue gang solve: scan apps in order, carrying
+    availability.  Infeasible apps are skipped (no subtraction), exactly
+    like a queue of Filter calls draining one by one.
+
+    with_placements=False returns only the per-app decisions (feasible,
+    driver_idx) and the final availability — the decision-latency path;
+    any single app's placement is recomputable via solve_single.
+    """
+    n = avail.shape[0]
+
+    def step(carry_avail, app):
+        driver, executor, k, valid = app
+        solve = solve_app(carry_avail, driver_rank, exec_ok, driver, executor, k)
+        feasible = solve.feasible & valid
+        solve = AppSolve(
+            feasible=feasible,
+            driver_idx=jnp.where(feasible, solve.driver_idx, jnp.int32(n)),
+            exec_counts=jnp.where(feasible, solve.exec_counts, jnp.zeros_like(solve.exec_counts)),
+            exec_capacity=solve.exec_capacity,
+        )
+        delta = usage_delta(solve, driver, executor, n, evenly)
+        if with_placements:
+            out = solve
+        else:
+            out = (feasible, solve.driver_idx)
+        return carry_avail - delta, out
+
+    avail_after, outs = lax.scan(step, avail, (drivers, executors, counts, app_valid))
+    if with_placements:
+        return QueueSolve(
+            feasible=outs.feasible,
+            driver_idx=outs.driver_idx,
+            exec_counts=outs.exec_counts,
+            exec_capacity=outs.exec_capacity,
+            avail_after=avail_after,
+        )
+    feasible, driver_idx = outs
+    return QueueSolve(
+        feasible=feasible,
+        driver_idx=driver_idx,
+        exec_counts=jnp.zeros((0,), jnp.int32),
+        exec_capacity=jnp.zeros((0,), jnp.int32),
+        avail_after=avail_after,
+    )
+
+
+@jax.jit
+def solve_single(
+    avail: jnp.ndarray,
+    driver_rank: jnp.ndarray,
+    exec_ok: jnp.ndarray,
+    driver: jnp.ndarray,
+    executor: jnp.ndarray,
+    k: jnp.ndarray,
+) -> AppSolve:
+    """Single-app entry point for the Filter hot path."""
+    return solve_app(avail, driver_rank, exec_ok, driver, executor, k)

@@ -1,0 +1,47 @@
+"""Device-mesh utilities: sharding the solver's node axis over ICI.
+
+The scaling axis of this framework is cluster size × queue depth
+(SURVEY §5 long-context note): a 10k-node × 1k-app snapshot is held in
+HBM with the node axis sharded across the mesh.  All cross-device
+communication is XLA collectives inserted by GSPMD from sharding
+annotations — reductions (total capacity), cumulative sums (greedy
+fill), and argmin (driver selection) ride the ICI ring; the scan over
+apps is sequential per-step but every step's node work is fully
+parallel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the node axis.  On a v5e-8 slice this is the 8-chip
+    ICI ring; on CPU tests it's the virtual-device array."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (NODE_AXIS,))
+
+
+def node_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def node_matrix_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(NODE_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, devices: int) -> int:
+    """Node-axis length must divide evenly across the mesh."""
+    if n % devices == 0:
+        return n
+    return n + devices - (n % devices)

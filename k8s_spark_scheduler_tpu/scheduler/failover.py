@@ -13,7 +13,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from ..types.objects import Node, Pod, PodPhase
+from ..types.objects import Node, Pod, PodPhase, Reservation
 from ..types.resources import (
     NodeGroupResources,
     Resources,
@@ -226,8 +226,6 @@ class _Reconciler:
                 if i >= max_extra:
                     break
                 try:
-                    from ..types.objects import Reservation
-
                     self.soft_reservations.add_reservation_for_pod(
                         app_id,
                         extra_executor.name,

@@ -235,8 +235,14 @@ class ResourceReservationManager:
                 self._bind_executor_to_resource_reservation(pod, reservation_name, reservation_node)
                 self._soft_reservations.remove_executor_reservation(app_id, pod.name)
                 return
+        # cross-node: bind keeping the RESERVATION's node (the reference
+        # passes unboundReservationsToNodes[name], resourcereservations.go
+        # :326-335 — the reservation stays on its node and, since the pod
+        # runs elsewhere, remains discoverable as unbound for rebinding)
         reservation_name = next(iter(unbound))
-        self._bind_executor_to_resource_reservation(pod, reservation_name, pod.node_name)
+        self._bind_executor_to_resource_reservation(
+            pod, reservation_name, unbound[reservation_name]
+        )
         self._soft_reservations.remove_executor_reservation(app_id, pod.name)
 
     def _drain_da_compaction_apps(self) -> Dict[str, str]:

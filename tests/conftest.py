@@ -1,17 +1,25 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
+The container's sitecustomize registers the axon TPU tunnel and imports
+jax before any test code runs, so setting JAX_PLATFORMS here is too
+late — instead update the live config.  XLA_FLAGS still works because
+the CPU backend initializes lazily on first device use.
+
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on 8 virtual CPU devices exactly as the driver's
-``dryrun_multichip`` does.  Must run before the first ``import jax``.
+``dryrun_multichip`` does.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,291 @@
+"""Decision-parity suite: the JAX batch solver must match the host
+oracles decision-for-decision (the BASELINE gate: zero gang-feasibility
+regressions).  Randomized differential testing over clusters with
+heterogeneous sizes, zones, unschedulable nodes, GPU dims, fractional
+quantities, and FIFO queues."""
+
+import random
+
+import numpy as np
+import pytest
+
+from k8s_spark_scheduler_tpu.ops import packers
+from k8s_spark_scheduler_tpu.ops.batch_adapter import (
+    TpuBatchBinpacker,
+    counts_to_evenly_list,
+    counts_to_tightly_list,
+    evenly_counts,
+)
+from k8s_spark_scheduler_tpu.ops.nodesort import NodeSorter
+from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
+from k8s_spark_scheduler_tpu.ops.tensorize import (
+    scale_problem,
+    tensorize_apps,
+    tensorize_cluster,
+)
+from k8s_spark_scheduler_tpu.types.resources import (
+    NodeSchedulingMetadata,
+    Resources,
+    copy_metadata,
+    subtract_usage_if_exists,
+)
+
+
+def random_cluster(rng, n_nodes, fractional=False):
+    metadata = {}
+    for i in range(n_nodes):
+        if fractional:
+            cpu = f"{rng.randint(1, 64)}500m" if rng.random() < 0.5 else str(rng.randint(1, 64))
+            mem = f"{rng.randint(1, 64)}Gi" if rng.random() < 0.7 else f"{rng.randint(512, 4096)}Mi"
+        else:
+            cpu = str(rng.randint(1, 64))
+            mem = f"{rng.randint(1, 64)}Gi"
+        gpu = str(rng.choice([0, 0, 0, 1, 4, 8]))
+        md = NodeSchedulingMetadata(
+            available=Resources.of(cpu, mem, gpu),
+            schedulable=Resources.of("64", "64Gi", "8"),
+            zone_label=f"z{rng.randint(0, 2)}",
+            unschedulable=rng.random() < 0.1,
+            ready=rng.random() > 0.05,
+        )
+        metadata[f"node-{i:03d}"] = md
+    return metadata
+
+
+def random_app(rng, gpu_prob=0.2):
+    return AppDemand(
+        driver_resources=Resources.of(
+            rng.choice(["1", "2", "500m", "1500m"]),
+            rng.choice(["1Gi", "2Gi", "512Mi"]),
+            "1" if rng.random() < gpu_prob else "0",
+        ),
+        executor_resources=Resources.of(
+            rng.choice(["1", "2", "4", "500m"]),
+            rng.choice(["1Gi", "2Gi", "4Gi"]),
+            "1" if rng.random() < gpu_prob else "0",
+        ),
+        min_executor_count=rng.randint(0, 40),
+    )
+
+
+def orders_for(metadata, rng):
+    priority = NodeSorter().potential_nodes(metadata, list(metadata))
+    driver_order, executor_order = priority
+    # sometimes restrict driver candidates (kube-scheduler filtering)
+    if rng.random() < 0.5 and driver_order:
+        keep = max(1, len(driver_order) // 2)
+        driver_order = [n for n in driver_order if rng.random() < 0.7][:keep] or driver_order[:1]
+    return driver_order, executor_order
+
+
+@pytest.mark.parametrize("fractional", [False, True])
+@pytest.mark.parametrize("policy,oracle", [
+    ("tightly-pack", packers.tightly_pack),
+    ("distribute-evenly", packers.distribute_evenly),
+])
+def test_single_app_parity_random(policy, oracle, fractional):
+    rng = random.Random(42 if not fractional else 1337)
+    solver = TpuBatchBinpacker(assignment_policy=policy)
+    for trial in range(40):
+        metadata = random_cluster(rng, rng.randint(1, 24), fractional=fractional)
+        app = random_app(rng)
+        driver_order, executor_order = orders_for(metadata, rng)
+
+        expected = oracle(
+            app.driver_resources,
+            app.executor_resources,
+            app.min_executor_count,
+            driver_order,
+            executor_order,
+            copy_metadata(metadata),
+        )
+        actual = solver(
+            app.driver_resources,
+            app.executor_resources,
+            app.min_executor_count,
+            driver_order,
+            executor_order,
+            copy_metadata(metadata),
+        )
+        assert actual.has_capacity == expected.has_capacity, f"trial {trial}: feasibility"
+        if expected.has_capacity:
+            assert actual.driver_node == expected.driver_node, f"trial {trial}: driver"
+            assert actual.executor_nodes == expected.executor_nodes, f"trial {trial}: placement"
+
+
+def test_queue_parity_fifo_scan():
+    """Whole-queue scan vs sequential oracle + the reference's usage
+    subtraction (fitEarlierDrivers semantics, feasible apps placed,
+    infeasible skipped)."""
+    import jax.numpy as jnp
+
+    from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue
+
+    rng = random.Random(7)
+    for trial in range(15):
+        metadata = random_cluster(rng, rng.randint(2, 20))
+        apps = [random_app(rng) for _ in range(rng.randint(1, 12))]
+        driver_order, executor_order = orders_for(metadata, rng)
+
+        # sequential oracle
+        meta_seq = copy_metadata(metadata)
+        expected = []
+        for app in apps:
+            result = packers.tightly_pack(
+                app.driver_resources,
+                app.executor_resources,
+                app.min_executor_count,
+                driver_order,
+                executor_order,
+                meta_seq,
+            )
+            expected.append(result)
+            if result.has_capacity:
+                from k8s_spark_scheduler_tpu.scheduler.sparkpods import spark_resource_usage
+
+                subtract_usage_if_exists(
+                    meta_seq,
+                    spark_resource_usage(
+                        app.driver_resources,
+                        app.executor_resources,
+                        result.driver_node,
+                        result.executor_nodes,
+                    ),
+                )
+
+        # batched scan
+        cluster = tensorize_cluster(metadata, driver_order, executor_order)
+        app_tensor = tensorize_apps(apps)
+        problem = scale_problem(cluster, app_tensor)
+        assert problem.ok
+        out = solve_queue(
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(problem.driver),
+            jnp.asarray(problem.executor),
+            jnp.asarray(problem.count),
+            jnp.asarray(problem.app_valid),
+        )
+        feasible = np.asarray(out.feasible)[: len(apps)]
+        driver_idx = np.asarray(out.driver_idx)[: len(apps)]
+        counts = np.asarray(out.exec_counts)[: len(apps), : len(cluster.node_names)]
+        for i, (app, exp) in enumerate(zip(apps, expected)):
+            assert bool(feasible[i]) == exp.has_capacity, f"trial {trial} app {i} feasibility"
+            if exp.has_capacity:
+                assert cluster.node_names[driver_idx[i]] == exp.driver_node, (
+                    f"trial {trial} app {i} driver"
+                )
+                assert (
+                    counts_to_tightly_list(cluster.node_names, counts[i])
+                    == exp.executor_nodes
+                ), f"trial {trial} app {i} placement"
+
+
+def test_evenly_counts_closed_form_matches_simulation():
+    rng = random.Random(99)
+    for _ in range(200):
+        n = rng.randint(1, 12)
+        cap = np.array([rng.randint(0, 9) for _ in range(n)], dtype=np.int64)
+        total = int(cap.sum())
+        if total == 0:
+            continue
+        k = rng.randint(1, total)
+        counts = evenly_counts(cap.copy(), k)
+        # simulate the Go round-robin
+        sim = np.zeros(n, dtype=np.int64)
+        remaining = k
+        alive = [i for i in range(n) if cap[i] > 0]
+        while remaining > 0:
+            for i in list(alive):
+                if sim[i] == cap[i]:
+                    alive.remove(i)
+                    continue
+                sim[i] += 1
+                remaining -= 1
+                if remaining == 0:
+                    break
+        assert (counts == sim).all(), (cap, k, counts, sim)
+        # and the emitted list matches the round-robin visit order
+        names = [f"n{i}" for i in range(n)]
+        out = counts_to_evenly_list(names, counts)
+        sim_list = []
+        sim2 = np.zeros(n, dtype=np.int64)
+        remaining = k
+        while remaining > 0:
+            progressed = False
+            for i in range(n):
+                if sim2[i] < counts[i]:
+                    sim_list.append(names[i])
+                    sim2[i] += 1
+                    remaining -= 1
+                    progressed = True
+                    if remaining == 0:
+                        break
+            assert progressed
+        assert out == sim_list
+
+
+def test_zero_executor_gang():
+    metadata = {
+        "a": NodeSchedulingMetadata(
+            available=Resources.of(1, "1Gi"), schedulable=Resources.of(8, "8Gi")
+        )
+    }
+    solver = TpuBatchBinpacker()
+    result = solver(Resources.of(1, "1Gi"), Resources.of(1, "1Gi"), 0, ["a"], ["a"], metadata)
+    assert result.has_capacity and result.executor_nodes == []
+
+
+def test_zero_resource_executors():
+    metadata = {
+        "a": NodeSchedulingMetadata(
+            available=Resources.of(1, "1Gi"), schedulable=Resources.of(8, "8Gi")
+        )
+    }
+    solver = TpuBatchBinpacker()
+    expected = packers.tightly_pack(
+        Resources.of(1, "1Gi"), Resources.zero(), 5, ["a"], ["a"], copy_metadata(metadata)
+    )
+    result = solver(Resources.of(1, "1Gi"), Resources.zero(), 5, ["a"], ["a"], metadata)
+    assert result.has_capacity == expected.has_capacity
+    assert result.executor_nodes == expected.executor_nodes
+
+
+def test_negative_availability():
+    metadata = {
+        "neg": NodeSchedulingMetadata(
+            available=Resources.of(4, "4Gi").sub(Resources.of(8, "8Gi")),
+            schedulable=Resources.of(8, "8Gi"),
+        ),
+        "ok": NodeSchedulingMetadata(
+            available=Resources.of(4, "4Gi"), schedulable=Resources.of(8, "8Gi")
+        ),
+    }
+    order = ["neg", "ok"]
+    solver = TpuBatchBinpacker()
+    expected = packers.tightly_pack(
+        Resources.of(1, "1Gi"), Resources.of(1, "1Gi"), 2, order, order, copy_metadata(metadata)
+    )
+    result = solver(Resources.of(1, "1Gi"), Resources.of(1, "1Gi"), 2, order, order, metadata)
+    assert result.has_capacity == expected.has_capacity == True  # noqa: E712
+    assert result.driver_node == expected.driver_node == "ok"
+    assert result.executor_nodes == expected.executor_nodes
+
+
+def test_inexact_quantities_fall_back_to_oracle():
+    # sub-milli CPU can't be represented in milli units → host oracle
+    metadata = {
+        "a": NodeSchedulingMetadata(
+            available=Resources.of("100u", "1Gi"), schedulable=Resources.of(8, "8Gi")
+        )
+    }
+    solver = TpuBatchBinpacker()
+    result = solver(
+        Resources.of("50u", "1Mi"), Resources.of("10u", "1Mi"), 2, ["a"], ["a"], metadata
+    )
+    expected = packers.tightly_pack(
+        Resources.of("50u", "1Mi"), Resources.of("10u", "1Mi"), 2, ["a"], ["a"], metadata
+    )
+    assert result.has_capacity == expected.has_capacity
+    assert result.executor_nodes == expected.executor_nodes

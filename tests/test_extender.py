@@ -289,3 +289,35 @@ def test_unschedulable_marker_clears_when_fits(harness):
     fresh = harness.api.get("Pod", "default", driver.name)
     cond = fresh.conditions.get("PodExceedsClusterCapacity")
     assert cond is not None and cond.status == "False"
+
+
+def test_dynamic_allocation_cross_node_compaction_keeps_reservation_node(harness):
+    """resourcereservations.go:326-335: when a soft-reserved executor runs
+    on node A and the only unbound hard reservation is on node B, the
+    compacted binding keeps the reservation on B (and it stays
+    discoverable as unbound since the pod runs elsewhere)."""
+    harness.new_node("n1", cpu="4", memory="4Gi")
+    harness.new_node("n2", cpu="4", memory="4Gi")
+    nodes = ["n1", "n2"]
+    pods = harness.dynamic_allocation_spark_pods("app-x", 1, 2)
+    driver, execs = pods[0], pods[1:]
+    harness.assert_success(harness.schedule(driver, nodes))
+    rr = harness.get_resource_reservation("app-x")
+    hard_node = rr.spec.reservations["executor-1"].node
+
+    # bind the hard reservation, then a soft executor
+    harness.assert_success(harness.schedule(execs[0], nodes))
+    harness.assert_success(harness.schedule(execs[1], nodes))
+    sr, _ = harness.server.soft_reservation_store.get_soft_reservation("app-x")
+    soft_node = sr.reservations[execs[1].name].node
+
+    # kill the hard-reserved executor; compaction moves the soft executor
+    # onto the freed hard reservation
+    harness.delete_pod(execs[0])
+    probe = harness.static_allocation_spark_pods("probe2", 0)[0]
+    harness.schedule(probe, nodes)
+
+    rr = harness.get_resource_reservation("app-x")
+    assert rr.status.pods["executor-1"] == execs[1].name
+    # the reservation's node must be unchanged even if the pod runs elsewhere
+    assert rr.spec.reservations["executor-1"].node == hard_node

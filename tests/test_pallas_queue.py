@@ -1,0 +1,82 @@
+"""Pallas queue-kernel parity vs the XLA scan (interpret mode on CPU;
+the same kernel runs compiled on TPU — bench.py exercises that path)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue
+from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue
+from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
+from k8s_spark_scheduler_tpu.ops.tensorize import (
+    scale_problem,
+    tensorize_apps,
+    tensorize_cluster,
+)
+from k8s_spark_scheduler_tpu.types.resources import (
+    NodeSchedulingMetadata,
+    Resources,
+)
+
+from test_batch_parity import orders_for, random_app, random_cluster
+
+
+def _problem(rng, n_nodes, n_apps):
+    metadata = random_cluster(rng, n_nodes)
+    apps = [random_app(rng) for _ in range(n_apps)]
+    driver_order, executor_order = orders_for(metadata, rng)
+    cluster = tensorize_cluster(metadata, driver_order, executor_order)
+    app_tensor = tensorize_apps(apps)
+    problem = scale_problem(cluster, app_tensor)
+    assert problem.ok
+    return problem
+
+
+@pytest.mark.parametrize("evenly", [False, True])
+def test_pallas_matches_xla_scan(evenly):
+    rng = random.Random(2024)
+    for trial in range(6):
+        problem = _problem(rng, rng.randint(2, 40), rng.randint(1, 24))
+        args = (
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(problem.driver),
+            jnp.asarray(problem.executor),
+            jnp.asarray(problem.count),
+            jnp.asarray(problem.app_valid),
+        )
+        ref = solve_queue(*args, evenly=evenly, with_placements=False)
+        feas, didx, avail_after = pallas_solve_queue(*args, evenly=evenly, interpret=True)
+        assert (np.asarray(feas) == np.asarray(ref.feasible)).all(), f"trial {trial}"
+        assert (np.asarray(didx) == np.asarray(ref.driver_idx)).all(), f"trial {trial}"
+        assert (np.asarray(avail_after) == np.asarray(ref.avail_after)).all(), f"trial {trial}"
+
+
+def test_pallas_empty_and_infeasible():
+    # all-infeasible queue must leave availability untouched
+    metadata = {
+        "a": NodeSchedulingMetadata(
+            available=Resources.of(1, "1Gi"), schedulable=Resources.of(8, "8Gi")
+        )
+    }
+    apps = [
+        AppDemand(Resources.of(4, "4Gi"), Resources.of(1, "1Gi"), 2),
+        AppDemand(Resources.of(1, "1Gi"), Resources.of(8, "8Gi"), 1),
+    ]
+    cluster = tensorize_cluster(metadata, ["a"], ["a"])
+    problem = scale_problem(cluster, tensorize_apps(apps))
+    feas, didx, avail_after = pallas_solve_queue(
+        jnp.asarray(problem.avail),
+        jnp.asarray(problem.driver_rank),
+        jnp.asarray(problem.exec_ok),
+        jnp.asarray(problem.driver),
+        jnp.asarray(problem.executor),
+        jnp.asarray(problem.count),
+        jnp.asarray(problem.app_valid),
+        interpret=True,
+    )
+    assert not np.asarray(feas)[:2].any()
+    assert (np.asarray(avail_after) == np.asarray(problem.avail)).all()
