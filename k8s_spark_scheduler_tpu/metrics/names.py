@@ -1,0 +1,67 @@
+"""Metric name catalog (reference internal/metrics/metrics.go:30-68)."""
+
+REQUEST_COUNTER = "foundry.spark.scheduler.requests"
+SCHEDULING_PROCESSING_TIME = "foundry.spark.scheduler.schedule.time"
+RECONCILIATION_TIME = "foundry.spark.scheduler.reconciliation.time"
+SCHEDULING_WAIT_TIME = "foundry.spark.scheduler.wait.time"
+SCHEDULING_RETRY_TIME = "foundry.spark.scheduler.retry.time"
+RESOURCE_USAGE_CPU = "foundry.spark.scheduler.resource.usage.cpu"
+RESOURCE_USAGE_MEMORY = "foundry.spark.scheduler.resource.usage.memory"
+RESOURCE_USAGE_NVIDIA_GPUS = "foundry.spark.scheduler.resource.usage.nvidia.com/gpu"
+LIFECYCLE_AGE_MAX = "foundry.spark.scheduler.pod.lifecycle.max"
+LIFECYCLE_AGE_P95 = "foundry.spark.scheduler.pod.lifecycle.p95"
+LIFECYCLE_AGE_P50 = "foundry.spark.scheduler.pod.lifecycle.p50"
+LIFECYCLE_COUNT = "foundry.spark.scheduler.pod.lifecycle.count"
+SINGLE_AZ_DA_PACK_FAILURE_COUNT = (
+    "foundry.spark.scheduler.singleazdynamicallocationpackfailure.count"
+)
+CROSS_AZ_TRAFFIC = "foundry.spark.scheduler.az.cross.traffic"
+CROSS_AZ_TRAFFIC_MEAN = "foundry.spark.scheduler.az.cross.traffic.mean"
+TOTAL_TRAFFIC = "foundry.spark.scheduler.total.traffic"
+TOTAL_TRAFFIC_MEAN = "foundry.spark.scheduler.total.traffic.mean"
+APPLICATION_ZONES_COUNT = "foundry.spark.scheduler.application.zones.count"
+CLIENT_REQUEST_LATENCY = "foundry.spark.scheduler.client.request.latency"
+CLIENT_REQUEST_RESULT = "foundry.spark.scheduler.client.request.result"
+CACHED_OBJECT_COUNT = "foundry.spark.scheduler.cache.objects.count"
+INFLIGHT_REQUEST_COUNT = "foundry.spark.scheduler.cache.inflight.count"
+UNBOUND_CPU_RESERVATIONS = "foundry.spark.scheduler.reservations.unbound.cpu"
+UNBOUND_MEMORY_RESERVATIONS = "foundry.spark.scheduler.reservations.unbound.memory"
+UNBOUND_NVIDIA_GPU_RESERVATIONS = "foundry.spark.scheduler.reservations.unbound.nvidiagpu"
+TIME_TO_FIRST_BIND = "foundry.spark.scheduler.reservations.timetofirstbind"
+TIME_TO_FIRST_BIND_MEDIAN = "foundry.spark.scheduler.reservations.timetofirstbind.median"
+TIME_TO_FIRST_BIND_MEAN = "foundry.spark.scheduler.reservations.timetofirstbind.mean"
+SOFT_RESERVATION_COUNT = "foundry.spark.scheduler.softreservation.count"
+SOFT_RESERVATION_EXECUTOR_COUNT = "foundry.spark.scheduler.softreservation.executorcount"
+EXECUTORS_WITH_NO_RESERVATION_COUNT = (
+    "foundry.spark.scheduler.softreservation.executorswithnoreservations"
+)
+SOFT_RESERVATION_COMPACTION_TIME = "foundry.spark.scheduler.softreservation.compaction.time"
+POD_INFORMER_DELAY = "foundry.spark.scheduler.informer.delay"
+SCHEDULING_WASTE = "foundry.spark.scheduler.scheduling.waste"
+SCHEDULING_WASTE_PER_INSTANCE_GROUP = (
+    "foundry.spark.scheduler.scheduling.wasteperinstancegroup"
+)
+INITIAL_DRIVER_EXECUTOR_COLLOCATION = (
+    "foundry.spark.scheduler.scheduling.initialdriverexecutorcollocation"
+)
+INITIAL_EXECUTORS_PER_NODE = "foundry.spark.scheduler.scheduling.initialexecutorspernode"
+INITIAL_NODE_COUNT = "foundry.spark.scheduler.scheduling.initialnodecount"
+PACKING_EFFICIENCY = "foundry.spark.scheduler.packing.efficiency"
+ASYNC_CLIENT_REQUEST = "foundry.spark.scheduler.async.request.count"
+ASYNC_CLIENT_RETRIES = "foundry.spark.scheduler.async.request.retries.count"
+ASYNC_CLIENT_DROPPED = "foundry.spark.scheduler.async.request.dropped.count"
+
+# tag keys (metrics.go:70-85)
+TAG_SPARK_ROLE = "sparkrole"
+TAG_COLLOCATION_TYPE = "collocation-type"
+TAG_OUTCOME = "outcome"
+TAG_INSTANCE_GROUP = "instance-group"
+TAG_HOST = "nodename"
+TAG_LIFECYCLE = "lifecycle"
+TAG_QUEUE_INDEX = "queueIndex"
+TAG_WASTE_TYPE = "wastetype"
+TAG_ZONE = "zone"
+
+TICK_INTERVAL_SECONDS = 30.0
+SLOW_LOG_THRESHOLD_SECONDS = 45.0
+STUCK_POD_LOG_THRESHOLD_SECONDS = 12 * 3600.0

@@ -1,0 +1,90 @@
+"""CLI entry point (reference main.go + cmd/root.go + cmd/server.go).
+
+    python -m k8s_spark_scheduler_tpu.server [--port P] [--config FILE]
+    python -m k8s_spark_scheduler_tpu.server --version
+    python -m k8s_spark_scheduler_tpu.server --webhook-only [--port P]
+
+``--config`` takes a JSON file in the reference's install.yml shape
+(config/config.go keys).  ``--webhook-only`` serves just the CRD
+conversion webhook, mirroring the standalone
+spark-scheduler-conversion-webhook module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import faulthandler
+import json
+import logging
+import signal
+import sys
+
+from .. import __version__
+from ..config import Install
+from ..kube.apiserver import APIServer
+from .http import ExtenderHTTPServer
+from .wiring import init_server_with_clients
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-gang-scheduler")
+    parser.add_argument("--version", action="store_true", help="print version and exit")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--host", type=str, default="", help="bind address (default: all interfaces)")
+    parser.add_argument("--config", type=str, default=None, help="install config JSON file")
+    parser.add_argument(
+        "--webhook-only",
+        action="store_true",
+        help="serve only the CRD conversion webhook (standalone module)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.version:
+        print(__version__)
+        return 0
+
+    class _JsonFormatter(logging.Formatter):
+        def format(self, record):
+            return json.dumps(
+                {
+                    "time": self.formatTime(record),
+                    "level": record.levelname,
+                    "logger": record.name,
+                    "message": record.getMessage(),
+                }
+            )
+
+    handler = logging.StreamHandler()
+    handler.setFormatter(_JsonFormatter())
+    logging.basicConfig(level=logging.INFO, handlers=[handler])
+    # stacktrace-on-signal, as the reference registers in main.go:24-27
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+    if args.webhook_only:
+        http = ExtenderHTTPServer(None, port=args.port, webhook_only=True, host=args.host)
+        http.start()
+        print(f"conversion webhook serving on :{http.port}", flush=True)
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        http.stop()
+        return 0
+
+    install = Install()
+    if args.config:
+        with open(args.config) as f:
+            install = Install.from_dict(json.load(f))
+
+    api = APIServer()
+    scheduler = init_server_with_clients(api, install)
+    http = ExtenderHTTPServer(scheduler, port=args.port, host=args.host)
+    http.start()
+    print(f"extender serving on :{http.port} (binpack={install.binpack_algo})", flush=True)
+    try:
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    finally:
+        http.stop()
+        scheduler.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

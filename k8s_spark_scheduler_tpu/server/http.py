@@ -1,0 +1,143 @@
+"""HTTP surface: the kube-scheduler extender protocol + ops endpoints.
+
+- ``POST /predicates`` — ExtenderArgs JSON in, ExtenderFilterResult out
+  (reference cmd/endpoints.go:28-42)
+- ``POST /convert`` — CRD ConversionReview webhook
+  (internal/conversionwebhook/resource_reservation.go:33-98; also served
+  standalone, mirroring the spark-scheduler-conversion-webhook module)
+- ``GET /status/liveness`` / ``GET /status/readiness`` — management
+  probes (witchcraft server equivalents, examples/extender.yml:142-151)
+- ``GET /metrics`` — metrics registry snapshot (JSON)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..types import serde
+from .wiring import Server
+
+logger = logging.getLogger(__name__)
+
+
+def convert_review(body: dict) -> dict:
+    """Handle a ConversionReview: convert every object to the desired
+    apiVersion (conversion webhook contract)."""
+    request = body.get("request") or {}
+    uid = request.get("uid", "")
+    desired = request.get("desiredAPIVersion", "")
+    converted = []
+    try:
+        for obj in request.get("objects") or []:
+            converted.append(serde.convert_rr(obj, desired))
+        result = {"status": "Success"}
+    except Exception as err:  # conversion failures are reported, not raised
+        logger.exception("conversion failed")
+        converted = []
+        result = {"status": "Failed", "message": str(err)}
+    return {
+        "apiVersion": body.get("apiVersion", "apiextensions.k8s.io/v1"),
+        "kind": "ConversionReview",
+        "response": {"uid": uid, "convertedObjects": converted, "result": result},
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tpu-gang-scheduler"
+    scheduler: Optional[Server] = None
+    webhook_only: bool = False
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("http: " + fmt, *args)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    def do_GET(self):
+        if self.path == "/status/liveness":
+            self._send_json(200, {"status": "up"})
+        elif self.path == "/status/readiness":
+            ready = self.webhook_only or (
+                self.scheduler is not None
+                and self.scheduler.informer_factory.wait_for_cache_sync()
+            )
+            self._send_json(200 if ready else 503, {"ready": ready})
+        elif self.path == "/metrics" and self.scheduler is not None:
+            self._send_json(200, self.scheduler.metrics.snapshot())
+        else:
+            self._send_json(404, {"error": "not found"})
+
+    def do_POST(self):
+        try:
+            body = self._read_json()
+        except (ValueError, json.JSONDecodeError) as err:
+            self._send_json(400, {"error": f"bad json: {err}"})
+            return
+        if not isinstance(body, dict):
+            self._send_json(400, {"error": "body must be a JSON object"})
+            return
+
+        if self.path == "/predicates" and not self.webhook_only:
+            if self.scheduler is None:
+                self._send_json(503, {"error": "scheduler not ready"})
+                return
+            try:
+                args = serde.extender_args_from_dict(body)
+            except Exception as err:
+                self._send_json(400, {"error": f"bad ExtenderArgs: {err}"})
+                return
+            result = self.scheduler.extender.predicate(args)
+            self._send_json(200, serde.extender_filter_result_to_dict(result))
+        elif self.path == "/convert":
+            self._send_json(200, convert_review(body))
+        else:
+            self._send_json(404, {"error": "not found"})
+
+
+class ExtenderHTTPServer:
+    """The serving process: extender endpoints on the main port."""
+
+    def __init__(
+        self,
+        scheduler: Optional[Server],
+        port: int = 0,
+        webhook_only: bool = False,
+        host: str = "",
+    ):
+        # host="" binds all interfaces: kube-scheduler and the apiserver
+        # webhook dial the pod IP, not loopback
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"scheduler": scheduler, "webhook_only": webhook_only},
+        )
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="extender-http"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

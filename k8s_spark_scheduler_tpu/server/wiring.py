@@ -18,6 +18,8 @@ from ..kube import crd
 from ..kube.apiserver import APIServer
 from ..kube.informer import Informer, InformerFactory
 from ..metrics.registry import MetricsRegistry
+from ..metrics.reporters import ReporterSet
+from ..metrics.waste import WasteMetricsReporter
 from ..ops.nodesort import NodeSorter
 from ..ops.registry import select_binpacker
 from ..scheduler.demand_gc import start_demand_gc
@@ -57,14 +59,20 @@ class Server:
     unschedulable_marker: UnschedulablePodMarker
     metrics: MetricsRegistry
     event_log: EventLog
+    reporters: "ReporterSet" = None
+    waste_reporter: "WasteMetricsReporter" = None
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
         self.resource_reservation_cache.run()
         self.lazy_demand_informer.start()
         self.unschedulable_marker.start()
+        if self.reporters is not None:
+            self.reporters.start()
 
     def stop(self) -> None:
+        if self.reporters is not None:
+            self.reporters.stop()
         self.unschedulable_marker.stop()
         self.resource_reservation_cache.stop()
         self.demand_cache.stop()
@@ -111,6 +119,10 @@ def init_server_with_clients(
     rrm = ResourceReservationManager(rr_cache, soft_store, pod_lister, pod_informer)
     overhead = OverheadComputer(pod_informer, rrm)
 
+    # waste reporter (cmd/server.go:171-191 NewWasteMetricsReporter)
+    waste_reporter = WasteMetricsReporter(metrics, install.instance_group_label)
+    waste_reporter.start(pod_informer, lazy_demand_informer)
+
     # extender (cmd/server.go:171-191)
     node_sorter = NodeSorter(
         install.driver_prioritized_node_label, install.executor_prioritized_node_label
@@ -133,6 +145,7 @@ def init_server_with_clients(
         node_sorter=node_sorter,
         metrics=metrics,
         event_log=event_log,
+        waste_reporter=waste_reporter,
     )
     marker = UnschedulablePodMarker(
         api,
@@ -163,7 +176,9 @@ def init_server_with_clients(
         unschedulable_marker=marker,
         metrics=metrics,
         event_log=event_log,
+        waste_reporter=waste_reporter,
     )
+    server.reporters = ReporterSet(server)
     if start_background:
         server.start_background()
     return server

@@ -1,0 +1,375 @@
+"""Wire (de)serialization + CRD version conversion.
+
+Covers the reference's k8s JSON shapes for the extender protocol and the
+ResourceReservation v1beta1 ↔ v1beta2 conversion
+(lib/pkg/apis/sparkscheduler/v1beta1/conversion_resource_reservation.go:
+the v1beta1 schema is flat {Node, CPU, Memory}; lossless round-trips
+keep a JSON copy of the full v1beta2 spec in the
+``sparkscheduler.palantir.com/reservation-spec`` annotation), plus
+Demand v1alpha1 ↔ v1alpha2 (flat resources vs resource list).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ..utils.quantity import Quantity
+from .extenderapi import ExtenderArgs, ExtenderFilterResult
+from .objects import (
+    Container,
+    Demand,
+    DemandSpec,
+    DemandStatus,
+    DemandUnit,
+    ObjectMeta,
+    Pod,
+    Reservation,
+    ResourceReservation,
+    ResourceReservationSpec,
+    ResourceReservationStatus,
+)
+from .resources import RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_NVIDIA_GPU, Resources
+
+GROUP_NAME = "sparkscheduler.palantir.com"
+RESERVATION_SPEC_ANNOTATION_KEY = GROUP_NAME + "/reservation-spec"
+
+
+# ---------------------------------------------------------------------------
+# ObjectMeta
+# ---------------------------------------------------------------------------
+
+
+def meta_to_dict(meta: ObjectMeta) -> dict:
+    out: Dict[str, Any] = {
+        "name": meta.name,
+        "namespace": meta.namespace,
+        "labels": dict(meta.labels),
+        "annotations": dict(meta.annotations),
+        "creationTimestamp": meta.creation_timestamp,
+        "resourceVersion": str(meta.resource_version),
+        "uid": meta.uid,
+    }
+    if meta.deletion_timestamp is not None:
+        out["deletionTimestamp"] = meta.deletion_timestamp
+    return out
+
+
+def meta_from_dict(d: dict) -> ObjectMeta:
+    rv_raw = d.get("resourceVersion", 0)
+    try:
+        rv = int(rv_raw)
+    except (TypeError, ValueError):
+        rv = 0
+    return ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", "default"),
+        labels=dict(d.get("labels") or {}),
+        annotations=dict(d.get("annotations") or {}),
+        creation_timestamp=float(d.get("creationTimestamp") or 0.0),
+        deletion_timestamp=d.get("deletionTimestamp"),
+        resource_version=rv,
+        uid=d.get("uid", ""),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pod (k8s core/v1 subset used by the extender protocol)
+# ---------------------------------------------------------------------------
+
+
+def pod_from_dict(d: dict) -> Pod:
+    meta = meta_from_dict(d.get("metadata") or {})
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+
+    node_affinity: Dict[str, List[str]] = {}
+    affinity = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    required = affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    for term in required.get("nodeSelectorTerms") or []:
+        for expr in term.get("matchExpressions") or []:
+            if expr.get("operator") == "In":
+                node_affinity[expr.get("key", "")] = list(expr.get("values") or [])
+
+    containers = []
+    for c in spec.get("containers") or []:
+        requests = (c.get("resources") or {}).get("requests") or {}
+        containers.append(
+            Container(name=c.get("name", "main"), requests=Resources.from_dict(requests))
+        )
+
+    return Pod(
+        meta=meta,
+        scheduler_name=spec.get("schedulerName", ""),
+        node_name=spec.get("nodeName", ""),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        node_affinity=node_affinity,
+        containers=containers,
+        phase=status.get("phase", "Pending"),
+    )
+
+
+def pod_to_dict(pod: Pod) -> dict:
+    terms = []
+    if pod.node_affinity:
+        terms = [
+            {
+                "matchExpressions": [
+                    {"key": k, "operator": "In", "values": v}
+                    for k, v in pod.node_affinity.items()
+                ]
+            }
+        ]
+    return {
+        "metadata": meta_to_dict(pod.meta),
+        "spec": {
+            "schedulerName": pod.scheduler_name,
+            "nodeName": pod.node_name,
+            "nodeSelector": dict(pod.node_selector),
+            "affinity": {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": terms
+                    }
+                }
+            }
+            if terms
+            else {},
+            "containers": [
+                {"name": c.name, "resources": {"requests": c.requests.to_dict()}}
+                for c in pod.containers
+            ],
+        },
+        "status": {"phase": pod.phase},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Extender protocol
+# ---------------------------------------------------------------------------
+
+
+def extender_args_from_dict(d: dict) -> ExtenderArgs:
+    return ExtenderArgs(
+        pod=pod_from_dict(d.get("Pod") or d.get("pod") or {}),
+        node_names=list(d.get("NodeNames") or d.get("nodeNames") or []),
+    )
+
+
+def extender_filter_result_to_dict(result: ExtenderFilterResult) -> dict:
+    return result.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# ResourceReservation v1beta2 (storage) + v1beta1 (served)
+# ---------------------------------------------------------------------------
+
+
+def rr_spec_to_dict_v1beta2(spec: ResourceReservationSpec) -> dict:
+    return {
+        "reservations": {
+            name: {
+                "node": res.node,
+                "resources": {k: q.serialize() for k, q in res.resources.items()},
+            }
+            for name, res in spec.reservations.items()
+        }
+    }
+
+
+def rr_spec_from_dict_v1beta2(d: dict) -> ResourceReservationSpec:
+    reservations = {}
+    for name, r in (d.get("reservations") or {}).items():
+        reservations[name] = Reservation(
+            node=r.get("node", ""),
+            resources={k: Quantity(v) for k, v in (r.get("resources") or {}).items()},
+        )
+    return ResourceReservationSpec(reservations=reservations)
+
+
+def rr_to_dict_v1beta2(rr: ResourceReservation) -> dict:
+    return {
+        "apiVersion": f"{GROUP_NAME}/v1beta2",
+        "kind": "ResourceReservation",
+        "metadata": meta_to_dict(rr.meta),
+        "spec": rr_spec_to_dict_v1beta2(rr.spec),
+        "status": {"pods": dict(rr.status.pods)},
+    }
+
+
+def rr_from_dict_v1beta2(d: dict) -> ResourceReservation:
+    return ResourceReservation(
+        meta=meta_from_dict(d.get("metadata") or {}),
+        spec=rr_spec_from_dict_v1beta2(d.get("spec") or {}),
+        status=ResourceReservationStatus(pods=dict((d.get("status") or {}).get("pods") or {})),
+    )
+
+
+def rr_to_dict_v1beta1(rr: ResourceReservation) -> dict:
+    """ConvertFrom (v1beta2 → v1beta1), conversion_resource_reservation.go:
+    86-121: flat {node,cpu,memory} reservations + full v1beta2 spec JSON
+    kept in the reservation-spec annotation for lossless round trips."""
+    meta = meta_to_dict(rr.meta)
+    annotations = dict(meta.get("annotations") or {})
+    annotations[RESERVATION_SPEC_ANNOTATION_KEY] = json.dumps(
+        rr_spec_to_dict_v1beta2(rr.spec), sort_keys=True
+    )
+    meta["annotations"] = annotations
+    return {
+        "apiVersion": f"{GROUP_NAME}/v1beta1",
+        "kind": "ResourceReservation",
+        "metadata": meta,
+        "spec": {
+            "reservations": {
+                name: {
+                    "node": res.node,
+                    "cpu": res.resources.get(RESOURCE_CPU, Quantity(0)).serialize(),
+                    "memory": res.resources.get(RESOURCE_MEMORY, Quantity(0)).serialize(),
+                }
+                for name, res in rr.spec.reservations.items()
+            }
+        },
+        "status": {"pods": dict(rr.status.pods)},
+    }
+
+
+def rr_from_dict_v1beta1(d: dict) -> ResourceReservation:
+    """ConvertTo (v1beta1 → v1beta2), conversion_resource_reservation.go:
+    28-83: base values from the flat struct; any extra resource
+    dimensions (e.g. GPU) recovered from the reservation-spec annotation;
+    the annotation itself is dropped from the converted object."""
+    meta = meta_from_dict(d.get("metadata") or {})
+    annotation_json = meta.annotations.pop(RESERVATION_SPEC_ANNOTATION_KEY, None)
+
+    reservations: Dict[str, Reservation] = {}
+    for name, r in ((d.get("spec") or {}).get("reservations") or {}).items():
+        reservations[name] = Reservation(
+            node=r.get("node", ""),
+            resources={
+                RESOURCE_CPU: Quantity(r.get("cpu", "0")),
+                RESOURCE_MEMORY: Quantity(r.get("memory", "0")),
+            },
+        )
+
+    if annotation_json:
+        try:
+            annotation_spec = rr_spec_from_dict_v1beta2(json.loads(annotation_json))
+        except (ValueError, TypeError):
+            annotation_spec = None
+        if annotation_spec is not None:
+            for name, annotation_res in annotation_spec.reservations.items():
+                existing = reservations.get(name)
+                if existing is None:
+                    continue
+                for resource_name, quantity in annotation_res.resources.items():
+                    if resource_name not in existing.resources:
+                        existing.resources[resource_name] = quantity
+
+    return ResourceReservation(
+        meta=meta,
+        spec=ResourceReservationSpec(reservations=reservations),
+        status=ResourceReservationStatus(pods=dict((d.get("status") or {}).get("pods") or {})),
+    )
+
+
+def convert_rr(obj: dict, desired_api_version: str) -> dict:
+    """Webhook conversion entry: any served version → desired version."""
+    api_version = obj.get("apiVersion", "")
+    if api_version == desired_api_version:
+        return obj
+    if api_version.endswith("v1beta1"):
+        hub = rr_from_dict_v1beta1(obj)
+    elif api_version.endswith("v1beta2"):
+        hub = rr_from_dict_v1beta2(obj)
+    else:
+        raise ValueError(f"unknown apiVersion {api_version}")
+    if desired_api_version.endswith("v1beta2"):
+        return rr_to_dict_v1beta2(hub)
+    if desired_api_version.endswith("v1beta1"):
+        return rr_to_dict_v1beta1(hub)
+    raise ValueError(f"unknown desired apiVersion {desired_api_version}")
+
+
+# ---------------------------------------------------------------------------
+# Demand v1alpha2 (storage) + v1alpha1
+# ---------------------------------------------------------------------------
+
+SCALER_GROUP = "scaler.palantir.com"
+
+
+def demand_to_dict_v1alpha2(demand: Demand) -> dict:
+    return {
+        "apiVersion": f"{SCALER_GROUP}/v1alpha2",
+        "kind": "Demand",
+        "metadata": meta_to_dict(demand.meta),
+        "spec": {
+            "units": [
+                {
+                    "resources": u.resources.to_dict(),
+                    "count": u.count,
+                    "podNamesByNamespace": {k: list(v) for k, v in u.pod_names_by_namespace.items()},
+                }
+                for u in demand.spec.units
+            ],
+            "instanceGroup": demand.spec.instance_group,
+            "isLongLived": demand.spec.is_long_lived,
+            "enforceSingleZoneScheduling": demand.spec.enforce_single_zone_scheduling,
+            "zone": demand.spec.zone,
+        },
+        "status": {
+            "phase": demand.status.phase,
+            "lastTransitionTime": demand.status.last_transition_time,
+            "fulfilledZone": demand.status.fulfilled_zone,
+        },
+    }
+
+
+def demand_from_dict_v1alpha2(d: dict) -> Demand:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    units = [
+        DemandUnit(
+            resources=Resources.from_dict(u.get("resources") or {}),
+            count=int(u.get("count", 0)),
+            pod_names_by_namespace={
+                k: list(v) for k, v in (u.get("podNamesByNamespace") or {}).items()
+            },
+        )
+        for u in spec.get("units") or []
+    ]
+    return Demand(
+        meta=meta_from_dict(d.get("metadata") or {}),
+        spec=DemandSpec(
+            units=units,
+            instance_group=spec.get("instanceGroup", ""),
+            is_long_lived=bool(spec.get("isLongLived", False)),
+            enforce_single_zone_scheduling=bool(spec.get("enforceSingleZoneScheduling", False)),
+            zone=spec.get("zone"),
+        ),
+        status=DemandStatus(
+            phase=status.get("phase", ""),
+            last_transition_time=float(status.get("lastTransitionTime") or 0.0),
+            fulfilled_zone=status.get("fulfilledZone"),
+        ),
+    )
+
+
+def demand_to_dict_v1alpha1(demand: Demand) -> dict:
+    """v1alpha1 units use flat cpu/memory fields (types_demand.go v1alpha1)."""
+    d = demand_to_dict_v1alpha2(demand)
+    d["apiVersion"] = f"{SCALER_GROUP}/v1alpha1"
+    for u, unit in zip(d["spec"]["units"], demand.spec.units):
+        resources = u.pop("resources")
+        u["cpu"] = resources[RESOURCE_CPU]
+        u["memory"] = resources[RESOURCE_MEMORY]
+    return d
+
+
+def demand_from_dict_v1alpha1(d: dict) -> Demand:
+    converted = json.loads(json.dumps(d))
+    for u in (converted.get("spec") or {}).get("units") or []:
+        u["resources"] = {
+            RESOURCE_CPU: u.pop("cpu", "0"),
+            RESOURCE_MEMORY: u.pop("memory", "0"),
+        }
+    return demand_from_dict_v1alpha2(converted)

@@ -1,0 +1,196 @@
+"""Integration tests of the real HTTP server (reference
+cmd/integration/server_test.go shape: boot the full wiring, drive
+Predicate over the wire, poll for async effects)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from k8s_spark_scheduler_tpu.config import Install
+from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+from k8s_spark_scheduler_tpu.kube.crd import DEMAND_CRD_NAME, demand_crd_spec
+from k8s_spark_scheduler_tpu.server.http import ExtenderHTTPServer
+from k8s_spark_scheduler_tpu.server.wiring import init_server_with_clients
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types import serde
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.fixture
+def served():
+    api = APIServer()
+    api.create_crd(DEMAND_CRD_NAME, demand_crd_spec())
+    scheduler = init_server_with_clients(
+        api, Install(binpack_algo="tightly-pack"), demand_poll_interval=0.02
+    )
+    scheduler.lazy_demand_informer.wait_ready(5)
+    http = ExtenderHTTPServer(scheduler, port=0)
+    http.start()
+    yield api, scheduler, http
+    http.stop()
+    scheduler.stop()
+
+
+def _driver_pod_json(app_id="app-http", executors=2):
+    pods = Harness.static_allocation_spark_pods(app_id, executors)
+    return serde.pod_to_dict(pods[0]), [serde.pod_to_dict(p) for p in pods[1:]]
+
+
+def test_predicates_end_to_end(served):
+    api, scheduler, http = served
+    # create nodes directly on the shared api server
+    from k8s_spark_scheduler_tpu.types.objects import Node, ObjectMeta
+    from k8s_spark_scheduler_tpu.types.resources import Resources, ZONE_LABEL
+
+    for i in range(2):
+        api.create(
+            Node(
+                meta=ObjectMeta(
+                    name=f"n{i}",
+                    labels={ZONE_LABEL: "z1", "resource_channel": "batch-medium-priority"},
+                ),
+                allocatable=Resources.of("8", "8Gi", "1"),
+            )
+        )
+
+    driver_json, exec_jsons = _driver_pod_json()
+    # the driver pod exists in the cluster before kube-scheduler calls us
+    api.create(serde.pod_from_dict(driver_json))
+
+    status, result = _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+    assert status == 200
+    assert result["NodeNames"] and result["NodeNames"][0] in ("n0", "n1")
+
+    # reservation lands in the API server asynchronously
+    deadline = time.time() + 5
+    while time.time() < deadline and not api.list("ResourceReservation"):
+        time.sleep(0.01)
+    rrs = api.list("ResourceReservation")
+    assert len(rrs) == 1 and rrs[0].name == "app-http"
+
+    # bind the driver, then schedule executors over the wire
+    driver = api.get("Pod", "default", serde.pod_from_dict(driver_json).name)
+    driver.node_name = result["NodeNames"][0]
+    driver.phase = "Running"
+    api.update(driver)
+    for exec_json in exec_jsons:
+        api.create(serde.pod_from_dict(exec_json))
+        status, result = _post(
+            http.port, "/predicates", {"Pod": exec_json, "NodeNames": ["n0", "n1"]}
+        )
+        assert status == 200 and result["NodeNames"]
+
+
+def test_predicates_rejects_bad_payloads(served):
+    _, _, http = served
+    status, body = _post(http.port, "/predicates", {"Pod": {"metadata": {}}, "NodeNames": []})
+    # a pod with no spark role → failure result, not a 500
+    assert status == 200
+    assert not body.get("NodeNames")
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http.port}/predicates", data=b"{not json", method="POST"
+    )
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 400
+    assert raised
+
+
+def test_management_endpoints(served):
+    _, _, http = served
+    assert _get(http.port, "/status/liveness")[0] == 200
+    assert _get(http.port, "/status/readiness")[0] == 200
+    status, metrics = _get(http.port, "/metrics")
+    assert status == 200 and "counters" in metrics
+    assert _get(http.port, "/nope")[0] == 404
+
+
+def test_conversion_webhook_roundtrip(served):
+    _, _, http = served
+    from k8s_spark_scheduler_tpu.scheduler.reservations_manager import (
+        new_resource_reservation,
+    )
+    from k8s_spark_scheduler_tpu.types.resources import Resources
+
+    pods = Harness.static_allocation_spark_pods("app-conv", 1, executor_gpu="2")
+    rr = new_resource_reservation(
+        "n0", ["n1"], pods[0], Resources.of("1", "1Gi", "1"), Resources.of("2", "2Gi", "2")
+    )
+    v2 = serde.rr_to_dict_v1beta2(rr)
+
+    # v1beta2 → v1beta1
+    review = {
+        "request": {
+            "uid": "u1",
+            "desiredAPIVersion": "sparkscheduler.palantir.com/v1beta1",
+            "objects": [v2],
+        }
+    }
+    status, body = _post(http.port, "/convert", review)
+    assert status == 200
+    response = body["response"]
+    assert response["result"]["status"] == "Success"
+    v1 = response["convertedObjects"][0]
+    assert v1["apiVersion"].endswith("v1beta1")
+    assert v1["spec"]["reservations"]["driver"]["cpu"] == "1"
+    assert serde.RESERVATION_SPEC_ANNOTATION_KEY in v1["metadata"]["annotations"]
+
+    # v1beta1 → v1beta2 recovers the GPU dimension from the annotation
+    review = {
+        "request": {
+            "uid": "u2",
+            "desiredAPIVersion": "sparkscheduler.palantir.com/v1beta2",
+            "objects": [v1],
+        }
+    }
+    status, body = _post(http.port, "/convert", review)
+    back = body["response"]["convertedObjects"][0]
+    assert back["spec"]["reservations"]["executor-1"]["resources"]["nvidia.com/gpu"] == "2"
+    assert serde.RESERVATION_SPEC_ANNOTATION_KEY not in back["metadata"]["annotations"]
+    # full round trip is lossless
+    assert back["spec"] == v2["spec"]
+
+
+def test_standalone_webhook_module():
+    http = ExtenderHTTPServer(None, port=0, webhook_only=True)
+    http.start()
+    try:
+        status, body = _post(http.port, "/convert", {"request": {"uid": "x", "objects": []}})
+        assert status == 200 and body["response"]["result"]["status"] == "Success"
+        # predicates must not be served by the standalone webhook
+        status, _ = _post(http.port, "/predicates", {"Pod": {}, "NodeNames": []})
+        assert status == 404
+    finally:
+        http.stop()
+
+
+def test_cli_version():
+    from k8s_spark_scheduler_tpu.server.__main__ import main
+
+    assert main(["--version"]) == 0

@@ -1,0 +1,108 @@
+"""Metrics reporters + waste reporter tests."""
+
+import time
+
+import pytest
+
+from k8s_spark_scheduler_tpu.metrics import names
+from k8s_spark_scheduler_tpu.metrics.registry import MetricsRegistry
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types.objects import DemandPhase
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.close()
+
+
+def test_reporters_run_and_emit(harness):
+    harness.new_node("n1")
+    harness.new_node("n2")
+    pods = harness.static_allocation_spark_pods("app-m", 1)
+    harness.assert_success(harness.schedule(pods[0], ["n1", "n2"]))
+
+    # a pending driver for lifecycle metrics
+    pending = harness.static_allocation_spark_pods("app-pending", 50)[0]
+    harness.create_pod(pending)
+
+    harness.server.reporters.report_once()
+    m = harness.server.metrics
+
+    # reserved usage on the driver's node
+    rr = harness.get_resource_reservation("app-m")
+    node = rr.spec.reservations["driver"].node
+    tags = {names.TAG_HOST: node, names.TAG_INSTANCE_GROUP: "batch-medium-priority"}
+    assert m.get_gauge(names.RESOURCE_USAGE_CPU, tags) >= 1.0
+
+    # one pending pod in the queue lifecycle
+    assert m.get_gauge(names.LIFECYCLE_COUNT, {names.TAG_LIFECYCLE: "queued"}) == 1.0
+
+    # unbound executor reservation (executor not yet scheduled)
+    assert m.get_gauge(names.UNBOUND_CPU_RESERVATIONS) == 1.0
+
+    # cache drift should be zero after the write-back drains
+    harness.wait_for_api(lambda: len(harness.api.list("ResourceReservation")) == 1)
+    harness.server.reporters.report_once()
+    assert m.get_gauge(names.CACHED_OBJECT_COUNT + ".drift") == 0.0
+
+
+def test_schedule_outcome_metrics(harness):
+    harness.new_node("n1")
+    harness.new_node("n2")
+    driver = harness.static_allocation_spark_pods("app-1", 1)[0]
+    harness.assert_success(harness.schedule(driver, ["n1", "n2"]))
+    m = harness.server.metrics
+    assert (
+        m.get_counter(
+            "foundry.spark.scheduler.schedule.outcome",
+            {"instanceGroup": "batch-medium-priority", "role": "driver", "outcome": "success"},
+        )
+        == 1.0
+    )
+
+
+def test_waste_reporter_phases(harness):
+    harness.new_node("n1")
+    harness.new_node("n2")
+    m = harness.server.metrics
+
+    # path 1: scheduled without a demand
+    ok = harness.static_allocation_spark_pods("app-fast", 1)[0]
+    harness.assert_success(harness.schedule(ok, ["n1", "n2"]))
+    h = m.get_histogram(names.SCHEDULING_WASTE, {names.TAG_WASTE_TYPE: "total-time-no-demand"})
+    assert h["count"] == 1
+
+    # path 2: demand created, fulfilled, then scheduled
+    big = harness.static_allocation_spark_pods("app-slow", 40)[0]
+    harness.assert_failure(harness.schedule(big, ["n1", "n2"]))
+    assert harness.wait_for_api(lambda: len(harness.api.list("Demand")) == 1)
+
+    demand = harness.api.list("Demand")[0]
+    demand.status.phase = DemandPhase.FULFILLED
+    harness.api.update(demand)
+
+    # another failed attempt AFTER fulfillment (capacity not yet visible)
+    harness.assert_failure(harness.schedule(big, ["n1", "n2"]))
+
+    harness.new_node("n3", cpu="64", memory="64Gi")
+    harness.assert_success(harness.schedule(big, ["n1", "n2", "n3"]))
+
+    for waste_type in (
+        "before-demand-creation",
+        "after-demand-fulfilled",
+        "after-demand-fulfilled-since-last-failure",
+        "after-demand-fulfilled-failure-failure-fit",
+    ):
+        h = m.get_histogram(names.SCHEDULING_WASTE, {names.TAG_WASTE_TYPE: waste_type})
+        assert h["count"] == 1, waste_type
+
+
+def test_registry_timer_and_snapshot():
+    m = MetricsRegistry()
+    with m.timer("op.time", {"t": "x"}):
+        time.sleep(0.01)
+    snap = m.snapshot()
+    assert any(k.startswith("op.time") for k in snap["histograms"])
+    assert m.get_histogram("op.time", {"t": "x"})["count"] == 1
