@@ -1,0 +1,184 @@
+"""ctypes binding for the native snapshot maintainer (native/snapshot.cpp).
+
+Builds the shared library on first import with g++ (cached beside the
+source); degrades gracefully to a pure-numpy implementation when no
+compiler is available, so the framework never hard-depends on the
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "snapshot.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "_build", "libsnapshot.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB)
+            lib.snap_create.restype = ctypes.c_void_p
+            lib.snap_create.argtypes = [ctypes.c_int64]
+            lib.snap_destroy.argtypes = [ctypes.c_void_p]
+            lib.snap_size.restype = ctypes.c_int64
+            lib.snap_size.argtypes = [ctypes.c_void_p]
+            lib.snap_load.restype = ctypes.c_int
+            lib.snap_load.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+            lib.snap_apply_deltas.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            lib.snap_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.snap_scale_int32.restype = ctypes.c_int
+            lib.snap_scale_int32.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            _lib = lib
+        except Exception:
+            logger.warning("native snapshot library unavailable; using numpy fallback",
+                           exc_info=True)
+            _lib_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+class SnapshotMaintainer:
+    """Incrementally-maintained availability tensor with int32 scaling.
+
+    Production consumer: ops/tensorize.scale_problem routes its per-
+    dimension GCD/divide/bound-check through this class on every solver
+    marshal.  The delta API additionally supports a steady-state mode
+    (load once, apply reservation deltas as pods bind/die, scale per
+    request) for event-driven snapshot maintenance.
+    """
+
+    def __init__(self, avail_rows: np.ndarray):
+        avail_rows = np.ascontiguousarray(avail_rows, dtype=np.int64)
+        self._n = avail_rows.shape[0]
+        self._lib = _build_and_load()
+        self._handle = None
+        if self._lib is not None:
+            handle = self._lib.snap_create(self._n)
+            if handle and self._lib.snap_load(
+                ctypes.c_void_p(handle), avail_rows.ctypes.data_as(ctypes.c_void_p), self._n
+            ):
+                self._handle = ctypes.c_void_p(handle)
+            elif handle:
+                self._lib.snap_destroy(ctypes.c_void_p(handle))
+        if self._handle is None:
+            self._np = avail_rows.copy()
+
+    def __del__(self):
+        if getattr(self, "_handle", None) is not None and self._lib is not None:
+            self._lib.snap_destroy(self._handle)
+            self._handle = None
+
+    @property
+    def backend(self) -> str:
+        return "native" if self._handle is not None else "numpy"
+
+    @property
+    def n_nodes(self) -> int:
+        return self._n
+
+    def apply_deltas(self, node_idx: np.ndarray, deltas: np.ndarray) -> None:
+        """avail[idx] -= delta (use negative deltas to release)."""
+        node_idx = np.ascontiguousarray(node_idx, dtype=np.int32)
+        deltas = np.ascontiguousarray(deltas, dtype=np.int64)
+        if self._handle is not None:
+            self._lib.snap_apply_deltas(
+                self._handle,
+                node_idx.ctypes.data_as(ctypes.c_void_p),
+                deltas.ctypes.data_as(ctypes.c_void_p),
+                len(node_idx),
+            )
+        else:
+            valid = (node_idx >= 0) & (node_idx < self._n)
+            np.subtract.at(self._np, node_idx[valid], deltas[valid])
+
+    def read(self) -> np.ndarray:
+        if self._handle is not None:
+            out = np.empty((self._n, 3), dtype=np.int64)
+            self._lib.snap_read(self._handle, out.ctypes.data_as(ctypes.c_void_p))
+            return out
+        return self._np.copy()
+
+    def scale_int32(
+        self, demand_rows: np.ndarray, node_bucket: int
+    ) -> Tuple[bool, np.ndarray, np.ndarray, np.ndarray]:
+        """(ok, scaled_avail[node_bucket,3] int32, scaled_demands, scale[3])."""
+        demand_rows = np.ascontiguousarray(demand_rows, dtype=np.int64)
+        n_demands = demand_rows.shape[0]
+        if self._handle is not None:
+            out_avail = np.zeros((node_bucket, 3), dtype=np.int32)
+            out_demands = np.zeros((max(n_demands, 1), 3), dtype=np.int32)
+            out_scale = np.ones(3, dtype=np.int64)
+            ok = self._lib.snap_scale_int32(
+                self._handle,
+                demand_rows.ctypes.data_as(ctypes.c_void_p),
+                n_demands,
+                node_bucket,
+                out_avail.ctypes.data_as(ctypes.c_void_p),
+                out_demands.ctypes.data_as(ctypes.c_void_p),
+                out_scale.ctypes.data_as(ctypes.c_void_p),
+            )
+            return bool(ok), out_avail, out_demands[:n_demands], out_scale
+        return _numpy_scale_int32(self._np, demand_rows, node_bucket)
+
+
+def _numpy_scale_int32(avail: np.ndarray, demand_rows: np.ndarray, node_bucket: int):
+    INT32_SAFE = 2**31 - 1
+    n = avail.shape[0]
+    out_avail = np.zeros((max(node_bucket, 0), 3), dtype=np.int32)
+    out_demands = np.zeros((demand_rows.shape[0], 3), dtype=np.int32)
+    scale = np.ones(3, dtype=np.int64)
+    if node_bucket < n:  # same contract as snapshot.cpp:101
+        return False, out_avail, out_demands, scale
+    for d in range(3):
+        values = np.concatenate([avail[:, d], demand_rows[:, d]])
+        g = int(np.gcd.reduce(np.abs(values))) if len(values) else 1
+        g = max(g, 1)
+        scale[d] = g
+        sa = avail[:, d] // g
+        sd = demand_rows[:, d] // g
+        if (np.abs(sa) > INT32_SAFE).any() or (len(sd) and (np.abs(sd) > INT32_SAFE).any()):
+            return False, out_avail, out_demands, scale
+        out_avail[:n, d] = sa
+        out_demands[:, d] = sd
+    return True, out_avail, out_demands, scale

@@ -226,8 +226,11 @@ class TpuBatchBinpacker:
 
 
 def tpu_batch_binpacker() -> Binpacker:
+    from .fifo_solver import TpuFifoSolver
+
     return Binpacker(
         name=TPU_BATCH,
         binpack_func=TpuBatchBinpacker(assignment_policy="tightly-pack"),
         is_single_az=False,
+        queue_solver=TpuFifoSolver(assignment_policy="tightly-pack"),
     )

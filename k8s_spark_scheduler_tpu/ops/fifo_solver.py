@@ -1,0 +1,135 @@
+"""FIFO queue solver: the extender's earlier-drivers pass on device.
+
+Replaces the host loop of resource.go:224-262 (binpack every earlier
+driver, subtract its usage, fail if an enforced driver doesn't fit) with
+ONE whole-queue device solve (batch_solver.solve_queue), then packs the
+current driver against the resulting availability.  Decisions are
+bit-identical to the oracle loop (tests/test_fifo_solver.py); problems
+that can't be exactly tensorized fall back to the host path.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..types.resources import NodeGroupSchedulingMetadata, Resources
+from ..utils.quantity import Quantity
+from .batch_adapter import counts_to_evenly_list, counts_to_tightly_list, evenly_counts
+from .efficiency import compute_packing_efficiencies
+from .packers import PackingResult, empty_packing_result
+from .sparkapp import AppDemand
+from .tensorize import scale_problem, tensorize_apps, tensorize_cluster
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class FifoOutcome:
+    """Result of the combined earlier-drivers + current-driver solve."""
+
+    supported: bool  # False → caller must use the host oracle path
+    earlier_ok: bool = True  # False → an enforced earlier driver doesn't fit
+    result: Optional[PackingResult] = None  # current driver's packing
+
+
+class TpuFifoSolver:
+    """One device round for the whole FIFO queue + the current driver."""
+
+    def __init__(self, assignment_policy: str = "tightly-pack"):
+        self.assignment_policy = assignment_policy
+
+    def solve(
+        self,
+        metadata: NodeGroupSchedulingMetadata,
+        driver_order: Sequence[str],
+        executor_order: Sequence[str],
+        earlier_apps: List[AppDemand],
+        earlier_skip_allowed: List[bool],
+        current_app: AppDemand,
+    ) -> FifoOutcome:
+        import jax.numpy as jnp
+
+        from .batch_solver import solve_queue, solve_single
+
+        cluster = tensorize_cluster(metadata, driver_order, executor_order)
+        apps = tensorize_apps(list(earlier_apps) + [current_app])
+        problem = scale_problem(cluster, apps)
+        if not problem.ok:
+            return FifoOutcome(supported=False)
+
+        evenly = self.assignment_policy == "distribute-evenly"
+        n_earlier = len(earlier_apps)
+
+        if n_earlier > 0:
+            # whole-queue pass over the earlier drivers only
+            queue_valid = problem.app_valid.copy()
+            queue_valid[n_earlier:] = False
+            out = solve_queue(
+                jnp.asarray(problem.avail),
+                jnp.asarray(problem.driver_rank),
+                jnp.asarray(problem.exec_ok),
+                jnp.asarray(problem.driver),
+                jnp.asarray(problem.executor),
+                jnp.asarray(problem.count),
+                jnp.asarray(queue_valid),
+                evenly=evenly,
+                with_placements=False,
+            )
+            feasible = np.asarray(out.feasible)[:n_earlier]
+            # an enforced (old-enough) earlier driver that doesn't fit
+            # fails the whole request (resource.go:244-253)
+            for i in range(n_earlier):
+                if not feasible[i] and not earlier_skip_allowed[i]:
+                    return FifoOutcome(supported=True, earlier_ok=False)
+            avail_after = out.avail_after
+        else:
+            avail_after = jnp.asarray(problem.avail)
+
+        solve = solve_single(
+            avail_after,
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(problem.driver[n_earlier]),
+            jnp.asarray(problem.executor[n_earlier]),
+            jnp.asarray(problem.count[n_earlier]),
+        )
+        if not bool(solve.feasible):
+            return FifoOutcome(supported=True, earlier_ok=True, result=empty_packing_result())
+
+        names = cluster.node_names
+        driver_node = names[int(solve.driver_idx)]
+        k = current_app.min_executor_count
+        if evenly:
+            cap = np.asarray(solve.exec_capacity)[: len(names)]
+            counts = evenly_counts(cap, k)
+            executor_nodes = counts_to_evenly_list(names, counts)
+        else:
+            counts = np.asarray(solve.exec_counts)[: len(names)]
+            executor_nodes = counts_to_tightly_list(names, counts)
+
+        reserved = {driver_node: current_app.driver_resources}
+        exec_res = current_app.executor_resources
+        for name, c in zip(names, counts):
+            if c > 0:
+                total = Resources(
+                    Quantity(exec_res.cpu.exact * int(c)),
+                    Quantity(exec_res.memory.exact * int(c)),
+                    Quantity(exec_res.nvidia_gpu.exact * int(c)),
+                )
+                reserved[name] = reserved.get(name, Resources.zero()).add(total)
+
+        # efficiencies vs the FIFO-adjusted availability snapshot is what
+        # the oracle reports too (metadata mutated by the earlier pass);
+        # we report vs the original metadata — efficiency feeds metrics
+        # only on this path (non-single-AZ policies)
+        result = PackingResult(
+            driver_node=driver_node,
+            executor_nodes=executor_nodes,
+            has_capacity=True,
+            packing_efficiencies=compute_packing_efficiencies(metadata, reserved),
+        )
+        return FifoOutcome(supported=True, earlier_ok=True, result=result)

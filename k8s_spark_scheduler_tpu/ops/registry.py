@@ -29,6 +29,9 @@ class Binpacker:
     name: str
     binpack_func: SparkBinPackFunction
     is_single_az: bool
+    # device-side whole-queue FIFO solver (set for tpu-batch); None means
+    # the extender uses the host earlier-drivers loop
+    queue_solver: object = None
 
 
 _REGISTRY = {}

@@ -211,29 +211,20 @@ def scale_problem(
     executor_s = np.zeros((ab, DIMS), dtype=np.int32)
 
     if ok:
-        for d in range(DIMS):
-            values = np.concatenate(
-                [cluster.avail[:, d], apps.driver[:, d], apps.executor[:, d]]
-            )
-            g = 0
-            for v in values:
-                g = math.gcd(g, abs(int(v)))
-            g = max(g, 1)
-            scale[d] = g
-            scaled_nodes = cluster.avail[:, d] // g
-            scaled_driver = apps.driver[:, d] // g
-            scaled_executor = apps.executor[:, d] // g
-            hi = max(
-                (int(np.abs(scaled_nodes).max()) if n else 0),
-                (int(np.abs(scaled_driver).max()) if a else 0),
-                (int(np.abs(scaled_executor).max()) if a else 0),
-            )
-            if hi > INT32_SAFE:
-                ok = False
-                break
-            avail_s[:n, d] = scaled_nodes
-            driver_s[:a, d] = scaled_driver
-            executor_s[:a, d] = scaled_executor
+        # per-dimension GCD + divide + int32 bound check: runs in the
+        # native snapshot library when available (numpy otherwise)
+        from ..native import SnapshotMaintainer
+
+        demand_rows = np.concatenate([apps.driver, apps.executor], axis=0)
+        scaled_ok, scaled_avail, scaled_demands, scale = SnapshotMaintainer(
+            cluster.avail
+        ).scale_int32(demand_rows, nb)
+        if scaled_ok:
+            avail_s = scaled_avail
+            driver_s[:a] = scaled_demands[:a]
+            executor_s[:a] = scaled_demands[a : 2 * a]
+        else:
+            ok = False
 
     # int32 sum-overflow guard: capacities are clamped to k in-kernel, so
     # sums are bounded by Nb * max(k); require it fits int32

@@ -234,25 +234,46 @@ class SparkSchedulerExtender:
         except AnnotationError as err:
             raise SchedulingFailure(FAILURE_INTERNAL, f"failed to get spark resources: {err}")
 
+        packing_result = None
         if self._is_fifo:
             queued_drivers = self._pod_lister.list_earlier_drivers(driver)
-            ok = self._fit_earlier_drivers(
-                instance_group, queued_drivers, driver_node_names, executor_node_names, metadata
+            # tpu-batch: the whole earlier-drivers pass plus this driver's
+            # pack is ONE device solve (ops/fifo_solver); other policies
+            # run the host loop
+            outcome = self._try_device_fifo(
+                instance_group,
+                queued_drivers,
+                driver_node_names,
+                executor_node_names,
+                metadata,
+                app_resources,
             )
-            if not ok:
+            if outcome is not None and outcome.supported:
+                earlier_ok = outcome.earlier_ok
+                packing_result = outcome.result
+            else:
+                earlier_ok = self._fit_earlier_drivers(
+                    instance_group,
+                    queued_drivers,
+                    driver_node_names,
+                    executor_node_names,
+                    metadata,
+                )
+            if not earlier_ok:
                 self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
                 raise SchedulingFailure(
                     FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
                 )
 
-        packing_result = self.binpacker.binpack_func(
-            app_resources.driver_resources,
-            app_resources.executor_resources,
-            app_resources.min_executor_count,
-            driver_node_names,
-            executor_node_names,
-            metadata,
-        )
+        if packing_result is None:
+            packing_result = self.binpacker.binpack_func(
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+                driver_node_names,
+                executor_node_names,
+                metadata,
+            )
         if not packing_result.has_capacity:
             self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
             raise SchedulingFailure(FAILURE_FIT, "application does not fit to the cluster")
@@ -275,6 +296,58 @@ class SparkSchedulerExtender:
             packing_result.executor_nodes,
         )
         return packing_result.driver_node, SUCCESS
+
+    def _try_device_fifo(
+        self,
+        instance_group: str,
+        queued_drivers: List[Pod],
+        driver_node_names: List[str],
+        executor_node_names: List[str],
+        metadata,
+        app_resources,
+    ):
+        """Run the FIFO pass + current pack on device when the configured
+        binpacker provides a queue solver; returns None when unavailable
+        (host loop takes over)."""
+        solver = getattr(self.binpacker, "queue_solver", None)
+        if solver is None:
+            return None
+        from ..ops.sparkapp import AppDemand
+
+        earlier_apps = []
+        skip_allowed = []
+        for queued in queued_drivers:
+            try:
+                queued_resources = spark_resources(queued)
+            except AnnotationError:
+                logger.warning(
+                    "failed to get driver resources, skipping driver %s", queued.name
+                )
+                continue
+            earlier_apps.append(
+                AppDemand(
+                    queued_resources.driver_resources,
+                    queued_resources.executor_resources,
+                    queued_resources.min_executor_count,
+                )
+            )
+            skip_allowed.append(self._should_skip_driver_fifo(queued, instance_group))
+        try:
+            return solver.solve(
+                metadata,
+                driver_node_names,
+                executor_node_names,
+                earlier_apps,
+                skip_allowed,
+                AppDemand(
+                    app_resources.driver_resources,
+                    app_resources.executor_resources,
+                    app_resources.min_executor_count,
+                ),
+            )
+        except Exception:
+            logger.exception("device FIFO solve failed; falling back to host loop")
+            return None
 
     def _fit_earlier_drivers(
         self,

@@ -1,0 +1,126 @@
+// Native snapshot maintainer for the TPU gang scheduler.
+//
+// Holds the cluster availability tensor (nodes × {cpu milli, mem bytes,
+// gpu milli} as int64) in native memory, applies reservation deltas
+// incrementally, and produces the GCD-scaled int32 planes the device
+// solver consumes — the steady-state alternative to re-marshalling the
+// whole snapshot from Python objects on every Filter request (the role
+// the reference's in-memory caches play for its Go hot path,
+// internal/cache + lib/pkg/resources).
+//
+// C ABI, consumed from Python via ctypes.  All exactness rules match
+// ops/tensorize.py: values beyond int32 after scaling → not ok, caller
+// falls back to the host oracle.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kDims = 3;
+constexpr int64_t kInt32Safe = 2147483647LL;
+
+int64_t gcd64(int64_t a, int64_t b) {
+  if (a < 0) a = -a;
+  if (b < 0) b = -b;
+  while (b != 0) {
+    int64_t t = a % b;
+    a = b;
+    b = t;
+  }
+  return a;
+}
+
+struct Snapshot {
+  int64_t n_nodes = 0;
+  // column-major per-dimension planes for cache-friendly per-dim scans
+  std::vector<int64_t> avail[kDims];
+};
+
+}  // namespace
+
+extern "C" {
+
+void* snap_create(int64_t n_nodes) {
+  Snapshot* s = new (std::nothrow) Snapshot();
+  if (s == nullptr) return nullptr;
+  s->n_nodes = n_nodes;
+  for (int d = 0; d < kDims; ++d) s->avail[d].assign(n_nodes, 0);
+  return s;
+}
+
+void snap_destroy(void* handle) { delete static_cast<Snapshot*>(handle); }
+
+int64_t snap_size(void* handle) { return static_cast<Snapshot*>(handle)->n_nodes; }
+
+// Bulk-load node availability (row-major [n, 3] int64).
+int snap_load(void* handle, const int64_t* avail_rows, int64_t n) {
+  Snapshot* s = static_cast<Snapshot*>(handle);
+  if (n != s->n_nodes) return 0;
+  for (int64_t i = 0; i < n; ++i) {
+    for (int d = 0; d < kDims; ++d) s->avail[d][i] = avail_rows[i * kDims + d];
+  }
+  return 1;
+}
+
+// Apply reservation deltas: avail[idx] -= delta (row-major [count, 3]).
+// Negative deltas release capacity.  Out-of-range indices are ignored
+// (defensive: the control plane validates indices).
+void snap_apply_deltas(void* handle, const int32_t* node_idx,
+                       const int64_t* delta_rows, int64_t count) {
+  Snapshot* s = static_cast<Snapshot*>(handle);
+  for (int64_t i = 0; i < count; ++i) {
+    int32_t idx = node_idx[i];
+    if (idx < 0 || idx >= s->n_nodes) continue;
+    for (int d = 0; d < kDims; ++d) s->avail[d][idx] -= delta_rows[i * kDims + d];
+  }
+}
+
+// Read the raw availability back (row-major [n, 3] int64).
+void snap_read(void* handle, int64_t* out_rows) {
+  Snapshot* s = static_cast<Snapshot*>(handle);
+  for (int64_t i = 0; i < s->n_nodes; ++i) {
+    for (int d = 0; d < kDims; ++d) out_rows[i * kDims + d] = s->avail[d][i];
+  }
+}
+
+// Compute the per-dimension GCD over availability plus demand rows
+// (row-major [n_demands, 3]), then emit int32-scaled planes:
+//   out_avail: [node_bucket, 3] row-major int32 (zero padded)
+//   out_demands: [n_demands, 3] row-major int32
+//   out_scale: [3] int64 divisors
+// Returns 1 if everything fits int32 after scaling, else 0 (outputs
+// are then undefined and the caller must use the exact host path).
+int snap_scale_int32(void* handle, const int64_t* demand_rows, int64_t n_demands,
+                     int64_t node_bucket, int32_t* out_avail,
+                     int32_t* out_demands, int64_t* out_scale) {
+  Snapshot* s = static_cast<Snapshot*>(handle);
+  const int64_t n = s->n_nodes;
+  if (node_bucket < n) return 0;
+
+  for (int d = 0; d < kDims; ++d) {
+    int64_t g = 0;
+    const int64_t* col = s->avail[d].data();
+    for (int64_t i = 0; i < n; ++i) g = gcd64(g, col[i]);
+    for (int64_t j = 0; j < n_demands; ++j) g = gcd64(g, demand_rows[j * kDims + d]);
+    if (g == 0) g = 1;
+    out_scale[d] = g;
+
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t v = col[i] / g;
+      if (v > kInt32Safe || v < -kInt32Safe) return 0;
+      out_avail[i * kDims + d] = static_cast<int32_t>(v);
+    }
+    for (int64_t i = n; i < node_bucket; ++i) out_avail[i * kDims + d] = 0;
+    for (int64_t j = 0; j < n_demands; ++j) {
+      int64_t v = demand_rows[j * kDims + d] / g;
+      if (v > kInt32Safe || v < -kInt32Safe) return 0;
+      out_demands[j * kDims + d] = static_cast<int32_t>(v);
+    }
+  }
+  return 1;
+}
+
+}  // extern "C"

@@ -1,0 +1,134 @@
+"""Device FIFO solver parity vs the extender's host loop, plus
+end-to-end extender behavior under binpack: tpu-batch with FIFO."""
+
+import random
+import time
+
+import pytest
+
+from k8s_spark_scheduler_tpu.ops import packers
+from k8s_spark_scheduler_tpu.ops.fifo_solver import TpuFifoSolver
+from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
+from k8s_spark_scheduler_tpu.scheduler.sparkpods import spark_resource_usage
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types.resources import (
+    copy_metadata,
+    subtract_usage_if_exists,
+)
+
+from test_batch_parity import orders_for, random_app, random_cluster
+
+
+def host_fifo_oracle(metadata, driver_order, executor_order, earlier, skip_allowed, current):
+    """The reference's fitEarlierDrivers + final pack, on the oracles."""
+    meta = copy_metadata(metadata)
+    for app, skippable in zip(earlier, skip_allowed):
+        result = packers.tightly_pack(
+            app.driver_resources,
+            app.executor_resources,
+            app.min_executor_count,
+            driver_order,
+            executor_order,
+            meta,
+        )
+        if not result.has_capacity:
+            if skippable:
+                continue
+            return False, None
+        subtract_usage_if_exists(
+            meta,
+            spark_resource_usage(
+                app.driver_resources,
+                app.executor_resources,
+                result.driver_node,
+                result.executor_nodes,
+            ),
+        )
+    return True, packers.tightly_pack(
+        current.driver_resources,
+        current.executor_resources,
+        current.min_executor_count,
+        driver_order,
+        executor_order,
+        meta,
+    )
+
+
+def test_fifo_solver_parity_random():
+    rng = random.Random(31337)
+    solver = TpuFifoSolver()
+    for trial in range(25):
+        metadata = random_cluster(rng, rng.randint(2, 20))
+        driver_order, executor_order = orders_for(metadata, rng)
+        earlier = [random_app(rng) for _ in range(rng.randint(0, 8))]
+        skip_allowed = [rng.random() < 0.3 for _ in earlier]
+        current = random_app(rng)
+
+        expected_ok, expected_result = host_fifo_oracle(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        outcome = solver.solve(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        assert outcome.supported
+        assert outcome.earlier_ok == expected_ok, f"trial {trial}: earlier_ok"
+        if expected_ok:
+            assert outcome.result.has_capacity == expected_result.has_capacity, (
+                f"trial {trial}: current feasibility"
+            )
+            if expected_result.has_capacity:
+                assert outcome.result.driver_node == expected_result.driver_node, (
+                    f"trial {trial}: driver node"
+                )
+                assert outcome.result.executor_nodes == expected_result.executor_nodes, (
+                    f"trial {trial}: placement"
+                )
+
+
+def test_extender_tpu_batch_fifo_end_to_end():
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        nodes = ["n1", "n2"]
+        t0 = time.time()
+        blocked = h.static_allocation_spark_pods("app-old", 64, creation_timestamp=t0 - 100)[0]
+        newer = h.static_allocation_spark_pods("app-new", 1, creation_timestamp=t0)[0]
+        h.create_pod(blocked)
+        # FIFO through the device path blocks the newer driver
+        result = h.schedule(newer, nodes)
+        h.assert_failure(result)
+        assert "earlier drivers" in list(result.failed_nodes.values())[0]
+
+        # remove the blocker; the newer driver schedules via the device path
+        h.delete_pod(blocked)
+        h.assert_success(h.schedule(newer, nodes))
+        rr = h.get_resource_reservation("app-new")
+        assert rr is not None and len(rr.spec.reservations) == 2
+    finally:
+        h.close()
+
+
+def test_extender_tpu_batch_gang_semantics_match_tightly():
+    """The tpu-batch extender must make the same decisions as tightly-pack
+    on an identical scenario sequence."""
+    results = {}
+    for algo in ("tightly-pack", "tpu-batch"):
+        h = Harness(binpack_algo=algo, is_fifo=True)
+        try:
+            h.new_node("n1", cpu="6", memory="6Gi")
+            h.new_node("n2", cpu="6", memory="6Gi")
+            nodes = ["n1", "n2"]
+            log = []
+            for i, (app, execs) in enumerate([("a", 3), ("b", 4), ("c", 2)]):
+                pods = h.static_allocation_spark_pods(f"app-{app}", execs)
+                r = h.schedule(pods[0], nodes)
+                log.append((f"driver-{app}", tuple(r.node_names or [])))
+                if r.node_names:
+                    for p in pods[1:]:
+                        er = h.schedule(p, nodes)
+                        log.append((p.name, tuple(er.node_names or [])))
+            results[algo] = log
+        finally:
+            h.close()
+    assert results["tightly-pack"] == results["tpu-batch"]

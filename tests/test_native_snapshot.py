@@ -1,0 +1,108 @@
+"""Native snapshot maintainer tests (C++ lib + numpy fallback parity)."""
+
+import numpy as np
+import pytest
+
+from k8s_spark_scheduler_tpu.native import (
+    SnapshotMaintainer,
+    _numpy_scale_int32,
+    native_available,
+)
+
+
+def _rows(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return np.stack(
+        [
+            rng.randint(1, 96_000, n),             # milli-cpu
+            rng.randint(1, 256, n) * (1 << 30),    # bytes
+            rng.randint(0, 8, n) * 1000,           # milli-gpu
+        ],
+        axis=1,
+    ).astype(np.int64)
+
+
+def test_native_builds():
+    assert native_available(), "g++ toolchain is baked into the image; native must build"
+
+
+def test_load_read_roundtrip():
+    rows = _rows(100)
+    snap = SnapshotMaintainer(rows)
+    assert snap.backend == "native"
+    assert (snap.read() == rows).all()
+
+
+def test_apply_deltas_and_release():
+    rows = _rows(10)
+    snap = SnapshotMaintainer(rows)
+    idx = np.array([2, 5, 2], dtype=np.int32)
+    deltas = np.array(
+        [[1000, 1 << 30, 0], [2000, 2 << 30, 1000], [500, 0, 0]], dtype=np.int64
+    )
+    snap.apply_deltas(idx, deltas)
+    out = snap.read()
+    assert out[2, 0] == rows[2, 0] - 1500
+    assert out[5, 1] == rows[5, 1] - (2 << 30)
+    # release by negative delta restores exactly
+    snap.apply_deltas(idx, -deltas)
+    assert (snap.read() == rows).all()
+    # out-of-range indices ignored
+    snap.apply_deltas(np.array([999], dtype=np.int32), np.array([[1, 1, 1]], dtype=np.int64))
+    assert (snap.read() == rows).all()
+
+
+def test_scale_matches_numpy_fallback():
+    rows = _rows(257, seed=3)
+    demands = _rows(16, seed=4)
+    snap = SnapshotMaintainer(rows)
+    ok_n, avail_n, dem_n, scale_n = snap.scale_int32(demands, node_bucket=512)
+    ok_p, avail_p, dem_p, scale_p = _numpy_scale_int32(rows, demands, 512)
+    assert ok_n == ok_p == True  # noqa: E712
+    assert (scale_n == scale_p).all()
+    assert (avail_n == avail_p).all()
+    assert (dem_n == dem_p).all()
+    # exactness: scaled values * scale reproduce the originals
+    assert (avail_n[:257].astype(np.int64) * scale_n[None, :] == rows).all()
+
+
+def test_scale_overflow_flags_not_ok():
+    # two coprime huge values → per-dim gcd 1 → values exceed int32
+    rows = np.array([[2**40 + 1, 1, 0], [2**40 - 1, 1, 0]], dtype=np.int64)
+    snap = SnapshotMaintainer(rows)
+    ok, *_ = snap.scale_int32(np.zeros((0, 3), dtype=np.int64), node_bucket=8)
+    assert not ok
+
+
+def test_matches_tensorize_scaling():
+    """The native scaler must agree with ops.tensorize.scale_problem."""
+    from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
+    from k8s_spark_scheduler_tpu.ops.tensorize import (
+        scale_problem,
+        tensorize_apps,
+        tensorize_cluster,
+    )
+    from k8s_spark_scheduler_tpu.types.resources import (
+        NodeSchedulingMetadata,
+        Resources,
+    )
+
+    metadata = {
+        f"n{i}": NodeSchedulingMetadata(
+            available=Resources.of(f"{4 + i}", f"{8 + i}Gi"),
+            schedulable=Resources.of("64", "64Gi"),
+        )
+        for i in range(20)
+    }
+    order = sorted(metadata)
+    apps = [AppDemand(Resources.of("1", "2Gi"), Resources.of("2", "4Gi"), 3)]
+    cluster = tensorize_cluster(metadata, order, order)
+    app_tensor = tensorize_apps(apps)
+    problem = scale_problem(cluster, app_tensor)
+
+    snap = SnapshotMaintainer(cluster.avail)
+    demands = np.concatenate([app_tensor.driver, app_tensor.executor])
+    ok, avail, dems, scale = snap.scale_int32(demands, node_bucket=problem.avail.shape[0])
+    assert ok and problem.ok
+    assert (scale == problem.scale).all()
+    assert (avail == problem.avail).all()
