@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Submit a fake Spark application (annotated pods) against the extender,
+# mirroring the reference's examples/submit-test-spark-app.sh.
+set -euo pipefail
+APP_ID="${1:-test-app-$RANDOM}"
+EXECUTORS="${2:-2}"
+HOST="${3:-localhost:8080}"
+
+driver_payload() {
+cat <<JSON
+{"Pod": {"metadata": {"name": "${APP_ID}-driver",
+  "labels": {"spark-role": "driver", "spark-app-id": "${APP_ID}"},
+  "annotations": {"spark-driver-cpu": "1", "spark-driver-mem": "1Gi",
+                  "spark-executor-cpu": "1", "spark-executor-mem": "1Gi",
+                  "spark-executor-count": "${EXECUTORS}"}},
+ "spec": {"schedulerName": "spark-scheduler",
+  "affinity": {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+    {"nodeSelectorTerms": [{"matchExpressions":
+      [{"key": "resource_channel", "operator": "In", "values": ["batch-medium-priority"]}]}]}}}}},
+ "NodeNames": $(kubectl get nodes -o json | python3 -c 'import json,sys; print(json.dumps([n["metadata"]["name"] for n in json.load(sys.stdin)["items"]]))')}
+JSON
+}
+curl -s -X POST "http://${HOST}/predicates" -d "$(driver_payload)"

@@ -36,11 +36,15 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         try:
             if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
                 os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+                # atomic build: concurrent processes must never CDLL-load
+                # a partially written file
+                tmp = _LIB + f".tmp.{os.getpid()}"
                 subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB],
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
                     check=True,
                     capture_output=True,
                 )
+                os.replace(tmp, _LIB)
             lib = ctypes.CDLL(_LIB)
             lib.snap_create.restype = ctypes.c_void_p
             lib.snap_create.argtypes = [ctypes.c_int64]
@@ -66,6 +70,17 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p,
                 ctypes.c_void_p,
             ]
+            lib.snap_scale_rows.restype = ctypes.c_int
+            lib.snap_scale_rows.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
             _lib = lib
         except Exception:
             logger.warning("native snapshot library unavailable; using numpy fallback",
@@ -81,9 +96,8 @@ def native_available() -> bool:
 class SnapshotMaintainer:
     """Incrementally-maintained availability tensor with int32 scaling.
 
-    Production consumer: ops/tensorize.scale_problem routes its per-
-    dimension GCD/divide/bound-check through this class on every solver
-    marshal.  The delta API additionally supports a steady-state mode
+    The per-request marshal path uses the stateless
+    :func:`scale_rows_int32` below; this class adds the steady-state mode
     (load once, apply reservation deltas as pods bind/die, scale per
     request) for event-driven snapshot maintenance.
     """
@@ -160,6 +174,32 @@ class SnapshotMaintainer:
             )
             return bool(ok), out_avail, out_demands[:n_demands], out_scale
         return _numpy_scale_int32(self._np, demand_rows, node_bucket)
+
+
+def scale_rows_int32(avail_rows: np.ndarray, demand_rows: np.ndarray, node_bucket: int):
+    """Stateless per-request scaling (no handle allocation): the marshal
+    path's entry point.  Native-backed when available."""
+    avail_rows = np.ascontiguousarray(avail_rows, dtype=np.int64)
+    demand_rows = np.ascontiguousarray(demand_rows, dtype=np.int64)
+    lib = _build_and_load()
+    if lib is None:
+        return _numpy_scale_int32(avail_rows, demand_rows, node_bucket)
+    n = avail_rows.shape[0]
+    n_demands = demand_rows.shape[0]
+    out_avail = np.zeros((node_bucket, 3), dtype=np.int32)
+    out_demands = np.zeros((max(n_demands, 1), 3), dtype=np.int32)
+    out_scale = np.ones(3, dtype=np.int64)
+    ok = lib.snap_scale_rows(
+        avail_rows.ctypes.data_as(ctypes.c_void_p),
+        n,
+        demand_rows.ctypes.data_as(ctypes.c_void_p),
+        n_demands,
+        node_bucket,
+        out_avail.ctypes.data_as(ctypes.c_void_p),
+        out_demands.ctypes.data_as(ctypes.c_void_p),
+        out_scale.ctypes.data_as(ctypes.c_void_p),
+    )
+    return bool(ok), out_avail, out_demands[:n_demands], out_scale
 
 
 def _numpy_scale_int32(avail: np.ndarray, demand_rows: np.ndarray, node_bucket: int):

@@ -59,6 +59,30 @@ def evenly_counts(cap: np.ndarray, k: int) -> np.ndarray:
     return counts
 
 
+def build_reserved(
+    names: List[str],
+    counts: np.ndarray,
+    driver_node: str,
+    driver_resources: Resources,
+    executor_resources: Resources,
+) -> dict:
+    """Per-node reserved map for efficiency computation, identical to the
+    oracle's mutation of `reserved` (driver + count x executor per node),
+    in O(#hosting-nodes) exact arithmetic."""
+    from ..utils.quantity import Quantity
+
+    reserved = {driver_node: driver_resources}
+    for name, c in zip(names, counts):
+        if c > 0:
+            total = Resources(
+                Quantity(executor_resources.cpu.exact * int(c)),
+                Quantity(executor_resources.memory.exact * int(c)),
+                Quantity(executor_resources.nvidia_gpu.exact * int(c)),
+            )
+            reserved[name] = reserved.get(name, Resources.zero()).add(total)
+    return reserved
+
+
 def counts_to_tightly_list(names: List[str], counts: np.ndarray) -> List[str]:
     out: List[str] = []
     for name, c in zip(names, counts):
@@ -233,4 +257,137 @@ def tpu_batch_binpacker() -> Binpacker:
         binpack_func=TpuBatchBinpacker(assignment_policy="tightly-pack"),
         is_single_az=False,
         queue_solver=TpuFifoSolver(assignment_policy="tightly-pack"),
+    )
+
+
+class TpuSingleAzBinpacker:
+    """Single-AZ combinator on device (single_az.go:23-55): all zones
+    solved in one vmapped call, zone chosen on host with the oracle's
+    exact efficiency math (_choose_best_result).  az_aware=True adds the
+    cross-zone fallback (az_aware_pack_tightly.go:27-38)."""
+
+    def __init__(self, az_aware: bool = False):
+        self.az_aware = az_aware
+
+    def __call__(
+        self,
+        driver_resources: Resources,
+        executor_resources: Resources,
+        executor_count: int,
+        driver_node_priority_order: Sequence[str],
+        executor_node_priority_order: Sequence[str],
+        metadata: NodeGroupSchedulingMetadata,
+    ) -> PackingResult:
+        import jax.numpy as jnp
+
+        from .batch_solver import solve_single, solve_zones_jit
+        from .sparkapp import app_resources_of
+
+        cluster = tensorize_cluster(
+            metadata, driver_node_priority_order, executor_node_priority_order
+        )
+        apps = tensorize_apps(
+            [app_resources_of(driver_resources, executor_resources, executor_count)]
+        )
+        problem = scale_problem(cluster, apps)
+        oracle = (
+            packers.az_aware_tightly_pack if self.az_aware else packers.single_az_tightly_pack
+        )
+        if not problem.ok:
+            logger.warning("snapshot not exactly tensorizable; using host oracle")
+            return oracle(
+                driver_resources,
+                executor_resources,
+                executor_count,
+                driver_node_priority_order,
+                executor_node_priority_order,
+                metadata,
+            )
+
+        # zone ordering and per-zone executor availability follow the
+        # driver list's first-appearance order (single_az.go:30-45)
+        driver_zones_in_order, _ = packers.group_nodes_by_zone(
+            driver_node_priority_order, metadata
+        )
+        _, executor_by_zone = packers.group_nodes_by_zone(
+            executor_node_priority_order, metadata
+        )
+        candidate_zones = [z for z in driver_zones_in_order if z in executor_by_zone]
+
+        names = cluster.node_names
+        n = len(names)
+        nb = problem.avail.shape[0]
+        zone_of = {name: metadata[name].zone_label for name in names}
+        zone_masks = np.zeros((max(len(candidate_zones), 1), nb), dtype=bool)
+        for zi, zone in enumerate(candidate_zones):
+            for i, name in enumerate(names):
+                zone_masks[zi, i] = zone_of[name] == zone
+
+        solves = solve_zones_jit(
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(zone_masks),
+            jnp.asarray(problem.driver[0]),
+            jnp.asarray(problem.executor[0]),
+            jnp.asarray(problem.count[0]),
+        )
+        feasible = np.asarray(solves.feasible)
+        driver_idx = np.asarray(solves.driver_idx)
+        counts = np.asarray(solves.exec_counts)
+
+        results = []
+        for zi, zone in enumerate(candidate_zones):
+            if not feasible[zi]:
+                continue
+            driver_node = names[int(driver_idx[zi])]
+            zone_counts = counts[zi][:n]
+            results.append(
+                PackingResult(
+                    driver_node=driver_node,
+                    executor_nodes=counts_to_tightly_list(names, zone_counts),
+                    has_capacity=True,
+                    packing_efficiencies=compute_packing_efficiencies(
+                        metadata,
+                        build_reserved(
+                            names, zone_counts, driver_node, driver_resources, executor_resources
+                        ),
+                    ),
+                )
+            )
+
+        if results:
+            best = packers._choose_best_result(metadata, results)
+            # _choose_best_result can return the empty result when every
+            # candidate has zero avg efficiency (the documented quirk) —
+            # az-aware must then still take the cross-zone fallback, like
+            # az_aware_pack_tightly.go:34-37's has_capacity check
+            if best.has_capacity or not self.az_aware:
+                return best
+        if self.az_aware:
+            # cross-zone fallback: plain tightly-pack on device
+            return TpuBatchBinpacker(assignment_policy="tightly-pack")(
+                driver_resources,
+                executor_resources,
+                executor_count,
+                driver_node_priority_order,
+                executor_node_priority_order,
+                metadata,
+            )
+        return empty_packing_result()
+
+
+def tpu_batch_single_az_binpacker() -> Binpacker:
+    return Binpacker(
+        name="tpu-batch-single-az",
+        binpack_func=TpuSingleAzBinpacker(az_aware=False),
+        is_single_az=True,
+    )
+
+
+def tpu_batch_az_aware_binpacker() -> Binpacker:
+    return Binpacker(
+        name="tpu-batch-az-aware",
+        binpack_func=TpuSingleAzBinpacker(az_aware=True),
+        is_single_az=True,
     )

@@ -37,7 +37,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-BIG = jnp.int32(2**31 - 1)
+# plain int (not a jnp scalar): creating a device array at import time
+# would initialize the JAX backend as a side effect of merely importing
+# this module; int32 ops promote it correctly
+BIG = 2**31 - 1
 
 
 class AppSolve(NamedTuple):
@@ -224,3 +227,34 @@ def solve_single(
 ) -> AppSolve:
     """Single-app entry point for the Filter hot path."""
     return solve_app(avail, driver_rank, exec_ok, driver, executor, k)
+
+
+def solve_zones(
+    avail: jnp.ndarray,        # [N, 3] int32
+    driver_rank: jnp.ndarray,  # [N] int32
+    exec_ok: jnp.ndarray,      # [N] bool
+    zone_masks: jnp.ndarray,   # [Z, N] bool — node membership per zone
+    driver: jnp.ndarray,       # [3] int32
+    executor: jnp.ndarray,     # [3] int32
+    k: jnp.ndarray,            # [] int32
+) -> AppSolve:
+    """Per-zone gang solves in one shot (the single-AZ combinator's inner
+    loop, single_az.go:23-55): restrict driver candidates and executor
+    capacity to each zone and solve every zone at once via vmap.  Zone
+    selection (best avg packing efficiency) happens on host with the
+    oracle's float64 math for exact parity."""
+
+    def one_zone(mask):
+        return solve_app(
+            avail,
+            jnp.where(mask, driver_rank, BIG),
+            exec_ok & mask,
+            driver,
+            executor,
+            k,
+        )
+
+    return jax.vmap(one_zone)(zone_masks)
+
+
+solve_zones_jit = jax.jit(solve_zones)

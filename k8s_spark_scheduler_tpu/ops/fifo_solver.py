@@ -17,8 +17,12 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..types.resources import NodeGroupSchedulingMetadata, Resources
-from ..utils.quantity import Quantity
-from .batch_adapter import counts_to_evenly_list, counts_to_tightly_list, evenly_counts
+from .batch_adapter import (
+    build_reserved,
+    counts_to_evenly_list,
+    counts_to_tightly_list,
+    evenly_counts,
+)
 from .efficiency import compute_packing_efficiencies
 from .packers import PackingResult, empty_packing_result
 from .sparkapp import AppDemand
@@ -111,16 +115,10 @@ class TpuFifoSolver:
             counts = np.asarray(solve.exec_counts)[: len(names)]
             executor_nodes = counts_to_tightly_list(names, counts)
 
-        reserved = {driver_node: current_app.driver_resources}
-        exec_res = current_app.executor_resources
-        for name, c in zip(names, counts):
-            if c > 0:
-                total = Resources(
-                    Quantity(exec_res.cpu.exact * int(c)),
-                    Quantity(exec_res.memory.exact * int(c)),
-                    Quantity(exec_res.nvidia_gpu.exact * int(c)),
-                )
-                reserved[name] = reserved.get(name, Resources.zero()).add(total)
+        reserved = build_reserved(
+            names, counts, driver_node, current_app.driver_resources,
+            current_app.executor_resources,
+        )
 
         # efficiencies vs the FIFO-adjusted availability snapshot is what
         # the oracle reports too (metadata mutated by the earlier pass);

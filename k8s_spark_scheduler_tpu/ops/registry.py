@@ -20,6 +20,8 @@ SINGLE_AZ_TIGHTLY_PACK = "single-az-tightly-pack"
 SINGLE_AZ_MINIMAL_FRAGMENTATION = "single-az-minimal-fragmentation"
 MINIMAL_FRAGMENTATION = "minimal-fragmentation"
 TPU_BATCH = "tpu-batch"
+TPU_BATCH_SINGLE_AZ = "tpu-batch-single-az"
+TPU_BATCH_AZ_AWARE = "tpu-batch-az-aware"
 
 DEFAULT = DISTRIBUTE_EVENLY
 
@@ -51,22 +53,38 @@ register(MINIMAL_FRAGMENTATION, packers.minimal_fragmentation_pack, False)
 
 def select_binpacker(name: str) -> Binpacker:
     """binpack.go:52-58; unknown → distribute-evenly."""
-    if name == TPU_BATCH:
+    if name in (TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE):
         try:
             # imported lazily: pulls in jax
-            from .batch_adapter import tpu_batch_binpacker
+            from .batch_adapter import (
+                tpu_batch_az_aware_binpacker,
+                tpu_batch_binpacker,
+                tpu_batch_single_az_binpacker,
+            )
 
+            if name == TPU_BATCH_SINGLE_AZ:
+                return tpu_batch_single_az_binpacker()
+            if name == TPU_BATCH_AZ_AWARE:
+                return tpu_batch_az_aware_binpacker()
             return tpu_batch_binpacker()
         except ImportError:
+            # fall back to the host policy with the SAME placement and
+            # single-AZ semantics, not the default
+            fallback = {
+                TPU_BATCH: TIGHTLY_PACK,
+                TPU_BATCH_SINGLE_AZ: SINGLE_AZ_TIGHTLY_PACK,
+                TPU_BATCH_AZ_AWARE: AZ_AWARE_TIGHTLY_PACK,
+            }[name]
             logging.getLogger(__name__).error(
-                "binpack 'tpu-batch' configured but the JAX batch solver could "
-                "not be imported; falling back to %s",
-                DEFAULT,
+                "binpack %r configured but the JAX batch solver could not be "
+                "imported; falling back to %s",
+                name,
+                fallback,
                 exc_info=True,
             )
-            return _REGISTRY[DEFAULT]
+            return _REGISTRY[fallback]
     return _REGISTRY.get(name, _REGISTRY[DEFAULT])
 
 
 def available_binpackers() -> list[str]:
-    return sorted(_REGISTRY.keys() | {TPU_BATCH})
+    return sorted(_REGISTRY.keys() | {TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE})

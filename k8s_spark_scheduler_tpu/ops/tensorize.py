@@ -213,12 +213,12 @@ def scale_problem(
     if ok:
         # per-dimension GCD + divide + int32 bound check: runs in the
         # native snapshot library when available (numpy otherwise)
-        from ..native import SnapshotMaintainer
+        from ..native import scale_rows_int32
 
         demand_rows = np.concatenate([apps.driver, apps.executor], axis=0)
-        scaled_ok, scaled_avail, scaled_demands, scale = SnapshotMaintainer(
-            cluster.avail
-        ).scale_int32(demand_rows, nb)
+        scaled_ok, scaled_avail, scaled_demands, scale = scale_rows_int32(
+            cluster.avail, demand_rows, nb
+        )
         if scaled_ok:
             avail_s = scaled_avail
             driver_s[:a] = scaled_demands[:a]

@@ -124,3 +124,36 @@ int snap_scale_int32(void* handle, const int64_t* demand_rows, int64_t n_demands
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Stateless one-shot scaling (no handle): the per-request marshal path.
+// Same contract as snap_scale_int32 but reads availability directly from
+// the caller's buffer (row-major [n, 3] int64).
+int snap_scale_rows(const int64_t* avail_rows, int64_t n,
+                    const int64_t* demand_rows, int64_t n_demands,
+                    int64_t node_bucket, int32_t* out_avail,
+                    int32_t* out_demands, int64_t* out_scale) {
+  if (node_bucket < n) return 0;
+  for (int d = 0; d < kDims; ++d) {
+    int64_t g = 0;
+    for (int64_t i = 0; i < n; ++i) g = gcd64(g, avail_rows[i * kDims + d]);
+    for (int64_t j = 0; j < n_demands; ++j) g = gcd64(g, demand_rows[j * kDims + d]);
+    if (g == 0) g = 1;
+    out_scale[d] = g;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t v = avail_rows[i * kDims + d] / g;
+      if (v > kInt32Safe || v < -kInt32Safe) return 0;
+      out_avail[i * kDims + d] = static_cast<int32_t>(v);
+    }
+    for (int64_t i = n; i < node_bucket; ++i) out_avail[i * kDims + d] = 0;
+    for (int64_t j = 0; j < n_demands; ++j) {
+      int64_t v = demand_rows[j * kDims + d] / g;
+      if (v > kInt32Safe || v < -kInt32Safe) return 0;
+      out_demands[j * kDims + d] = static_cast<int32_t>(v);
+    }
+  }
+  return 1;
+}
+
+}  // extern "C"
