@@ -109,8 +109,26 @@ class APIServer:
             stored.meta.resource_version = self._rv
             self._objects[kind][key] = stored
             out = stored.deepcopy()
+            dangling = self._has_dangling_owner(stored)
         self._notify(kind, ADDED, stored)
+        if dangling:
+            # an object created with owner references to an already-dead
+            # owner: real k8s GC collects it shortly after; collecting it
+            # immediately keeps state deterministic when an async
+            # write-back create races the owner's deletion
+            try:
+                self.delete(kind, key[0], key[1])
+            except NotFoundError:
+                pass
         return out
+
+    def _has_dangling_owner(self, obj: APIObject) -> bool:
+        if not obj.meta.owner_references:
+            return False
+        live_uids = {
+            o.meta.uid for objs in self._objects.values() for o in objs.values()
+        }
+        return any(ref.uid and ref.uid not in live_uids for ref in obj.meta.owner_references)
 
     def update(self, obj: APIObject) -> APIObject:
         with self._lock:

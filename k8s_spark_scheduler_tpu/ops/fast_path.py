@@ -1,0 +1,121 @@
+"""Driver-path fast lane: TensorSnapshot → solver tensors with no
+Quantity arithmetic.
+
+Replicates, in vectorized integer math, exactly what the slow path
+derives from Quantity metadata:
+
+- the AZ-aware node priority order (nodesorting.go:95-122): zones
+  ascending by total (memory, cpu) of *available* resources, nodes by
+  (zone priority, memory, cpu, name) — int64 lexsorts, name ties via a
+  precomputed rank;
+- driver candidates = priority ∩ kube-scheduler's list; executor
+  candidates = ready ∧ ¬unschedulable (nodesorting.go:41-64);
+- the required-node-affinity filter over snapshot label dicts
+  (resource.go:292-295).
+
+Only usable when the snapshot is exact and no label-priority re-sort is
+configured; callers fall back to the Quantity path otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..state.tensor_snapshot import TensorSnapshot
+from .tensorize import INT32_SAFE, ClusterTensor
+
+
+def build_cluster_tensor(
+    snap: TensorSnapshot,
+    driver_pod,
+    candidate_names: List[str],
+) -> Optional[Tuple[ClusterTensor, Dict[str, str]]]:
+    """(cluster tensor, node→zone map) or None when the fast path can't
+    represent the snapshot exactly."""
+    if not snap.exact:
+        return None
+    n = len(snap.names)
+    if n == 0:
+        # no eligible nodes: an empty tensor is still valid input
+        empty = ClusterTensor(
+            node_names=[],
+            avail=np.zeros((0, 3), np.int64),
+            sched=np.zeros((0, 3), np.int64),
+            driver_rank=np.zeros(0, np.int32),
+            exec_ok=np.zeros(0, bool),
+            zone_id=np.zeros(0, np.int32),
+            zone_names=[],
+            valid=np.zeros(0, bool),
+            exact=True,
+        )
+        return empty, {}
+
+    # required node affinity + nodeSelector filter (metadata membership)
+    eligible = np.fromiter(
+        (
+            all(labels.get(k) == v for k, v in driver_pod.node_selector.items())
+            and all(
+                labels.get(k) in values for k, values in driver_pod.node_affinity.items()
+            )
+            for labels in snap.labels
+        ),
+        dtype=bool,
+        count=n,
+    )
+    idx = np.flatnonzero(eligible)
+    if len(idx) == 0:
+        idx = np.zeros(0, dtype=np.int64)
+
+    names = [snap.names[i] for i in idx]
+    avail = snap.avail[idx]
+    sched = snap.schedulable[idx]
+    zone_id = snap.zone_id[idx]
+    ready = snap.ready[idx]
+    unsched = snap.unschedulable[idx]
+
+    # AZ totals over eligible nodes → zone priority (memory, cpu, name asc)
+    n_zones = len(snap.zone_names)
+    zone_mem = np.zeros(n_zones, dtype=np.int64)
+    zone_cpu = np.zeros(n_zones, dtype=np.int64)
+    np.add.at(zone_mem, zone_id, avail[:, 1])
+    np.add.at(zone_cpu, zone_id, avail[:, 0])
+    zone_name_rank = np.argsort(np.argsort(np.array(snap.zone_names, dtype=object)))
+    zone_order = np.lexsort((zone_name_rank, zone_cpu, zone_mem))
+    zone_priority = np.empty(n_zones, dtype=np.int64)
+    zone_priority[zone_order] = np.arange(n_zones)
+
+    # node priority: (zone priority, memory, cpu, name)
+    name_rank = np.argsort(np.argsort(np.array(names, dtype=object)))
+    order = np.lexsort((name_rank, avail[:, 0], avail[:, 1], zone_priority[zone_id]))
+
+    candidate_set = set(candidate_names)
+    driver_rank = np.full(len(names), INT32_SAFE, dtype=np.int32)
+    rank = 0
+    exec_ok = np.zeros(len(names), dtype=bool)
+    ordered_names: List[str] = []
+    for pos in order:
+        name = names[pos]
+        ordered_names.append(name)
+        if name in candidate_set:
+            driver_rank[pos] = rank
+            rank += 1
+        exec_ok[pos] = bool(ready[pos]) and not bool(unsched[pos])
+
+    # the solver's array order must equal the executor priority order:
+    # reorder everything by `order`
+    perm = order
+    cluster = ClusterTensor(
+        node_names=ordered_names,
+        avail=avail[perm],
+        sched=sched[perm],
+        driver_rank=driver_rank[perm],
+        exec_ok=exec_ok[perm],
+        zone_id=zone_id[perm].astype(np.int32),
+        zone_names=list(snap.zone_names),
+        valid=np.ones(len(ordered_names), dtype=bool),
+        exact=True,
+    )
+    zones = {name: snap.zone_names[zone_id[pos]] for pos, name in zip(perm, ordered_names)}
+    return cluster, zones

@@ -26,9 +26,42 @@ from .batch_adapter import (
 from .efficiency import compute_packing_efficiencies
 from .packers import PackingResult, empty_packing_result
 from .sparkapp import AppDemand
+from .tensorize import _resources_to_base as _res_rows
 from .tensorize import scale_problem, tensorize_apps, tensorize_cluster
 
 logger = logging.getLogger(__name__)
+
+
+def _ceil_div(v: int, d: int) -> int:
+    return -((-v) // d)
+
+
+def efficiencies_from_rows(names, sched_rows, avail_rows, reserved_rows):
+    """compute_packing_efficiencies from exact base-unit int rows —
+    bit-identical floats to the Quantity path (efficiency.go:80-105):
+    per-dim reserved = schedulable − available + newly_reserved, then
+    Quantity.value() semantics (ceil to canonical units) and ratio."""
+    from .efficiency import PackingEfficiency
+
+    out = {}
+    for i, name in enumerate(names):
+        s_cpu = _ceil_div(int(sched_rows[i, 0]), 1000)
+        s_mem = int(sched_rows[i, 1])
+        s_gpu = _ceil_div(int(sched_rows[i, 2]), 1000)
+        r = sched_rows[i] - avail_rows[i] + reserved_rows[i]
+        r_cpu = _ceil_div(int(r[0]), 1000)
+        r_mem = int(r[1])
+        r_gpu = _ceil_div(int(r[2]), 1000)
+        gpu_eff = 0.0
+        if s_gpu != 0:
+            gpu_eff = float(r_gpu) / float(s_gpu if s_gpu != 0 else 1)
+        out[name] = PackingEfficiency(
+            node_name=name,
+            cpu=float(r_cpu) / float(s_cpu if s_cpu != 0 else 1),
+            memory=float(r_mem) / float(s_mem if s_mem != 0 else 1),
+            gpu=gpu_eff,
+        )
+    return out
 
 
 @dataclass
@@ -55,11 +88,26 @@ class TpuFifoSolver:
         earlier_skip_allowed: List[bool],
         current_app: AppDemand,
     ) -> FifoOutcome:
+        cluster = tensorize_cluster(metadata, driver_order, executor_order)
+        return self.solve_tensor(
+            cluster, earlier_apps, earlier_skip_allowed, current_app, metadata=metadata
+        )
+
+    def solve_tensor(
+        self,
+        cluster,
+        earlier_apps: List[AppDemand],
+        earlier_skip_allowed: List[bool],
+        current_app: AppDemand,
+        metadata: Optional[NodeGroupSchedulingMetadata] = None,
+    ) -> FifoOutcome:
+        """Solve from a prebuilt ClusterTensor (the tensor-snapshot fast
+        path passes one directly; `metadata` is only used for the
+        Quantity-based efficiency computation when provided)."""
         import jax.numpy as jnp
 
         from .batch_solver import solve_queue, solve_single
 
-        cluster = tensorize_cluster(metadata, driver_order, executor_order)
         apps = tensorize_apps(list(earlier_apps) + [current_app])
         problem = scale_problem(cluster, apps)
         if not problem.ok:
@@ -115,19 +163,30 @@ class TpuFifoSolver:
             counts = np.asarray(solve.exec_counts)[: len(names)]
             executor_nodes = counts_to_tightly_list(names, counts)
 
-        reserved = build_reserved(
-            names, counts, driver_node, current_app.driver_resources,
-            current_app.executor_resources,
-        )
-
-        # efficiencies vs the FIFO-adjusted availability snapshot is what
-        # the oracle reports too (metadata mutated by the earlier pass);
-        # we report vs the original metadata — efficiency feeds metrics
-        # only on this path (non-single-AZ policies)
+        # efficiencies feed metrics only on this path (non-single-AZ
+        # policies); computed vs the original snapshot like the oracle
+        if metadata is not None:
+            reserved = build_reserved(
+                names, counts, driver_node, current_app.driver_resources,
+                current_app.executor_resources,
+            )
+            efficiencies = compute_packing_efficiencies(metadata, reserved)
+        else:
+            # per-node reserved = count × executor (+ driver on its node)
+            reserved_rows = np.zeros_like(cluster.avail)
+            drv_row, _ = _res_rows(current_app.driver_resources)
+            exec_row, _ = _res_rows(current_app.executor_resources)
+            reserved_rows[int(solve.driver_idx)] += np.array(drv_row, np.int64)
+            reserved_rows[: len(names)] += (
+                counts.astype(np.int64)[:, None] * np.array(exec_row, np.int64)[None, :]
+            )
+            efficiencies = efficiencies_from_rows(
+                names, cluster.sched, cluster.avail, reserved_rows
+            )
         result = PackingResult(
             driver_node=driver_node,
             executor_nodes=executor_nodes,
             has_capacity=True,
-            packing_efficiencies=compute_packing_efficiencies(metadata, reserved),
+            packing_efficiencies=efficiencies,
         )
         return FifoOutcome(supported=True, earlier_ok=True, result=result)

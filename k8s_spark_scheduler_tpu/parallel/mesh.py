@@ -45,3 +45,47 @@ def pad_to_multiple(n: int, devices: int) -> int:
     if n % devices == 0:
         return n
     return n + devices - (n % devices)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host JAX runtime (jax.distributed): each scheduler
+    replica contributes its local chips and the mesh spans all hosts.
+
+    The intra-host slice of the node axis rides ICI; the cross-host hops
+    ride DCN — GSPMD emits hierarchical collectives from the same
+    sharding annotations, so the solver code is unchanged.  With no
+    arguments, configuration comes from the standard JAX env vars
+    (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) or the
+    TPU pod metadata.
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def make_multihost_mesh(devices_per_host_axis: bool = False) -> Mesh:
+    """Global mesh over every process's devices (call after
+    initialize_multihost).  A 1-D layout keeps neighboring node-axis
+    shards on intra-host ICI where possible; set devices_per_host_axis
+    for an explicit ('hosts', 'nodes') 2-D mesh when the control plane
+    wants to address per-host shards (e.g. host-local snapshots reduced
+    over DCN)."""
+    import jax
+
+    devices = jax.devices()
+    if not devices_per_host_axis:
+        return Mesh(np.array(devices), (NODE_AXIS,))
+    local = jax.local_device_count()
+    hosts = len(devices) // local
+    return Mesh(np.array(devices).reshape(hosts, local), ("hosts", NODE_AXIS))

@@ -92,6 +92,7 @@ class SparkSchedulerExtender:
         metrics: MetricsRegistry | None = None,
         event_log: Optional[ev.EventLog] = None,
         waste_reporter=None,
+        tensor_snapshot_cache=None,
     ):
         self._node_informer = node_informer
         self._pod_lister = pod_lister
@@ -109,6 +110,15 @@ class SparkSchedulerExtender:
         self._metrics = metrics or default_registry
         self._event_log = event_log
         self._waste_reporter = waste_reporter
+        # event-driven integer snapshot; usable for the driver fast path
+        # only when no label-priority re-sort is configured (the fast
+        # lexsort replicates the default NodeSorter ordering)
+        self._tensor_snapshot = tensor_snapshot_cache
+        self._fast_path_ok = (
+            tensor_snapshot_cache is not None
+            and node_sorter._driver_less_than is None
+            and node_sorter._executor_less_than is None
+        )
         self._last_request = 0.0
 
     # -- entry point ---------------------------------------------------------
@@ -219,6 +229,26 @@ class SparkSchedulerExtender:
                 )
             return driver_reserved_node, SUCCESS
 
+        try:
+            app_resources_early = spark_resources(driver)
+        except AnnotationError as err:
+            raise SchedulingFailure(FAILURE_INTERNAL, f"failed to get spark resources: {err}")
+        fast = self._try_fast_driver_path(
+            instance_group, driver, node_names, app_resources_early
+        )
+        if fast is not None:
+            outcome, zones = fast
+            if not outcome.earlier_ok:
+                self._demands.create_demand_for_application_in_any_zone(
+                    driver, app_resources_early
+                )
+                raise SchedulingFailure(
+                    FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
+                )
+            return self._finish_driver_selection(
+                instance_group, driver, app_resources_early, outcome.result, zones
+            )
+
         available_nodes: List[Node] = self._node_informer.list_with_predicate(
             lambda node: driver.matches_node(node)
         )
@@ -229,10 +259,7 @@ class SparkSchedulerExtender:
         driver_node_names, executor_node_names = self._node_sorter.potential_nodes(
             metadata, node_names
         )
-        try:
-            app_resources = spark_resources(driver)
-        except AnnotationError as err:
-            raise SchedulingFailure(FAILURE_INTERNAL, f"failed to get spark resources: {err}")
+        app_resources = app_resources_early
 
         packing_result = None
         if self._is_fifo:
@@ -274,19 +301,39 @@ class SparkSchedulerExtender:
                 executor_node_names,
                 metadata,
             )
+        efficiency = compute_avg_packing_efficiency(
+            metadata, list(packing_result.packing_efficiencies.values())
+        ) if packing_result.has_capacity else None
+        zones = {
+            node.name: node.labels.get(ZONE_LABEL, "") for node in available_nodes
+        }
+        return self._finish_driver_selection(
+            instance_group, driver, app_resources, packing_result, zones, efficiency
+        )
+
+    def _finish_driver_selection(
+        self, instance_group, driver, app_resources, packing_result, zones, efficiency=None
+    ) -> Tuple[str, str]:
+        """Common driver-path tail: demand lifecycle, metrics, reservation
+        creation (resource.go:347-369)."""
         if not packing_result.has_capacity:
             self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
             raise SchedulingFailure(FAILURE_FIT, "application does not fit to the cluster")
 
-        efficiency = compute_avg_packing_efficiency(
-            metadata, list(packing_result.packing_efficiencies.values())
-        )
+        if efficiency is None:
+            # fast path: average the per-node efficiencies directly (the
+            # device adapters compute them with exact value() semantics)
+            effs = list(packing_result.packing_efficiencies.values())
+            max_sum = sum(max(e.gpu, e.cpu, e.memory) for e in effs)
+            max_avg = max_sum / max(len(effs), 1)
+        else:
+            max_avg = efficiency.max
         self._metrics.gauge(
             "foundry.spark.scheduler.packing.efficiency.max",
-            efficiency.max,
+            max_avg,
             {"instanceGroup": instance_group, "binpacker": self.binpacker.name},
         )
-        self._report_placement_metrics(instance_group, packing_result, available_nodes)
+        self._report_placement_metrics(instance_group, packing_result, zones)
 
         self._demands.delete_demand_if_exists(driver, "SparkSchedulerExtender")
         self._rrm.create_reservations(
@@ -296,6 +343,62 @@ class SparkSchedulerExtender:
             packing_result.executor_nodes,
         )
         return packing_result.driver_node, SUCCESS
+
+    def _try_fast_driver_path(self, instance_group, driver, node_names, app_resources):
+        """Whole driver decision (FIFO pass + gang pack) from the
+        event-driven tensor snapshot: zero Quantity arithmetic.  Returns
+        (FifoOutcome, zones) or None to use the Quantity path."""
+        solver = getattr(self.binpacker, "queue_solver", None)
+        if solver is None or not self._fast_path_ok:
+            return None
+        try:
+            from ..ops.fast_path import build_cluster_tensor
+            from ..ops.sparkapp import AppDemand
+
+            snap = self._tensor_snapshot.snapshot()
+            built = build_cluster_tensor(snap, driver, list(node_names))
+            if built is None:
+                return None
+            cluster, zones = built
+
+            earlier_apps = []
+            skip_allowed = []
+            if self._is_fifo:
+                for queued in self._pod_lister.list_earlier_drivers(driver):
+                    try:
+                        queued_resources = spark_resources(queued)
+                    except AnnotationError:
+                        logger.warning(
+                            "failed to get driver resources, skipping driver %s",
+                            queued.name,
+                        )
+                        continue
+                    earlier_apps.append(
+                        AppDemand(
+                            queued_resources.driver_resources,
+                            queued_resources.executor_resources,
+                            queued_resources.min_executor_count,
+                        )
+                    )
+                    skip_allowed.append(
+                        self._should_skip_driver_fifo(queued, instance_group)
+                    )
+            outcome = solver.solve_tensor(
+                cluster,
+                earlier_apps,
+                skip_allowed,
+                AppDemand(
+                    app_resources.driver_resources,
+                    app_resources.executor_resources,
+                    app_resources.min_executor_count,
+                ),
+            )
+            if not outcome.supported:
+                return None
+            return outcome, zones
+        except Exception:
+            logger.exception("tensor-snapshot fast path failed; using Quantity path")
+            return None
 
     def _try_device_fifo(
         self,
@@ -644,7 +747,7 @@ class SparkSchedulerExtender:
 
     # -- metrics -------------------------------------------------------------
 
-    def _report_placement_metrics(self, instance_group, packing_result, available_nodes) -> None:
+    def _report_placement_metrics(self, instance_group, packing_result, zones) -> None:
         executor_nodes = set(packing_result.executor_nodes)
         self._metrics.gauge(
             "foundry.spark.scheduler.driver.executor.collocation",
@@ -656,9 +759,6 @@ class SparkSchedulerExtender:
             float(len(executor_nodes)),
             {"instanceGroup": instance_group},
         )
-        zones = {}
-        for node in available_nodes:
-            zones[node.name] = node.labels.get(ZONE_LABEL, "")
         used_zones = {zones.get(n, "") for n in executor_nodes | {packing_result.driver_node}}
         self._metrics.gauge(
             "foundry.spark.scheduler.app.cross.zone",

@@ -29,6 +29,7 @@ from ..scheduler.reservations_manager import ResourceReservationManager
 from ..scheduler.sparkpods import SparkPodLister
 from ..scheduler.unschedulable import UnschedulablePodMarker
 from ..state.softreservations import SoftReservationStore
+from ..state.tensor_snapshot import TensorSnapshotCache
 from ..state.typed_caches import (
     LazyDemandInformer,
     ResourceReservationCache,
@@ -56,6 +57,7 @@ class Server:
     resource_reservation_manager: ResourceReservationManager
     overhead_computer: OverheadComputer
     extender: SparkSchedulerExtender
+    tensor_snapshot: TensorSnapshotCache
     unschedulable_marker: UnschedulablePodMarker
     metrics: MetricsRegistry
     event_log: EventLog
@@ -119,6 +121,9 @@ def init_server_with_clients(
     rrm = ResourceReservationManager(rr_cache, soft_store, pod_lister, pod_informer)
     overhead = OverheadComputer(pod_informer, rrm)
 
+    # event-driven integer snapshot for the tpu-batch fast path
+    tensor_snapshot = TensorSnapshotCache(node_informer, pod_informer, rr_cache, soft_store)
+
     # waste reporter (cmd/server.go:171-191 NewWasteMetricsReporter)
     waste_reporter = WasteMetricsReporter(metrics, install.instance_group_label)
     waste_reporter.start(pod_informer, lazy_demand_informer)
@@ -146,6 +151,7 @@ def init_server_with_clients(
         metrics=metrics,
         event_log=event_log,
         waste_reporter=waste_reporter,
+        tensor_snapshot_cache=tensor_snapshot,
     )
     marker = UnschedulablePodMarker(
         api,
@@ -173,6 +179,7 @@ def init_server_with_clients(
         resource_reservation_manager=rrm,
         overhead_computer=overhead,
         extender=extender,
+        tensor_snapshot=tensor_snapshot,
         unschedulable_marker=marker,
         metrics=metrics,
         event_log=event_log,

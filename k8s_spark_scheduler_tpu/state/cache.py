@@ -179,7 +179,9 @@ class AsyncClient:
             if not self._maybe_retry(r, err):
                 self._store.delete(r.key)
             return
-        self._store.override_resource_version_if_newer(result)
+        # fold the result's RV in atomically, never resurrecting a key
+        # deleted (e.g. by owner GC) while the create was in flight
+        self._store.fold_resource_version(result)
 
     def _do_update(self, r: Request) -> None:
         obj = self._store.get(r.key)
@@ -189,19 +191,21 @@ class AsyncClient:
         try:
             result = self._client.update(obj)
         except kerrors.ConflictError:
-            # refresh RV from the server and retry inline (async.go:111-120)
+            # refresh RV from the server and retry inline (async.go:111-120);
+            # stop if the object vanished locally meanwhile
             try:
                 new_obj = self._client.get(r.key[0], r.key[1])
             except Exception as get_err:
                 self._maybe_retry(r, get_err)
                 return
-            self._store.override_resource_version_if_newer(new_obj)
+            if not self._store.fold_resource_version(new_obj):
+                return
             self._do_update(update_request(new_obj))
             return
         except Exception as err:
             self._maybe_retry(r, err)
             return
-        self._store.override_resource_version_if_newer(result)
+        self._store.fold_resource_version(result)
 
     def _do_delete(self, r: Request) -> None:
         self._mark(r, "request")

@@ -35,6 +35,8 @@ class SoftReservationStore:
     def __init__(self, pod_informer: Optional[Informer] = None):
         self._lock = threading.RLock()
         self._store: Dict[str, SoftReservation] = {}
+        # (node, Resources, +1/-1) observers for incremental usage mirrors
+        self._observers = []
         if pod_informer is not None:
             pod_informer.add_event_handler(
                 on_delete=self._on_pod_deletion,
@@ -68,6 +70,7 @@ class SoftReservationStore:
                 return
             sr.reservations[pod_name] = reservation
             sr.status[pod_name] = True
+            self._notify(reservation.node, reservation.resources_value(), +1, pod_name)
 
     def executor_has_soft_reservation(self, executor: Pod) -> bool:
         return self.get_executor_soft_reservation(executor) is not None
@@ -103,12 +106,17 @@ class SoftReservationStore:
             sr = self._store.get(app_id)
             if sr is None:
                 return
-            sr.reservations.pop(executor_name, None)
+            removed = sr.reservations.pop(executor_name, None)
             sr.status[executor_name] = False
+            if removed is not None:
+                self._notify(removed.node, removed.resources_value(), -1, executor_name)
 
     def remove_driver_reservation(self, app_id: str) -> None:
         with self._lock:
-            self._store.pop(app_id, None)
+            sr = self._store.pop(app_id, None)
+            if sr is not None:
+                for pod_name, reservation in sr.reservations.items():
+                    self._notify(reservation.node, reservation.resources_value(), -1, pod_name)
 
     def _on_pod_deletion(self, pod: Pod) -> None:
         app_id = pod.labels.get(SPARK_APP_ID_LABEL, "")
@@ -117,6 +125,20 @@ class SoftReservationStore:
             self.remove_driver_reservation(app_id)
         elif role == EXECUTOR:
             self.remove_executor_reservation(app_id, pod.name)
+
+    def add_change_observer(self, fn) -> None:
+        """fn(node, resources, sign, pod_name): called under the store lock
+        on every reservation add (+1) / removal (-1)."""
+        self._observers.append(fn)
+
+    def _notify(self, node: str, resources: Resources, sign: int, pod_name: str) -> None:
+        for fn in self._observers:
+            try:
+                fn(node, resources, sign, pod_name)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("soft reservation observer failed")
 
     # -- metrics helpers -----------------------------------------------------
 

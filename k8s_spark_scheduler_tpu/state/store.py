@@ -55,11 +55,44 @@ def delete_request(key: Key) -> Request:
 
 
 class ObjectStore:
-    """Thread-safe map[(ns,name)] → object (store.go:27-130)."""
+    """Thread-safe map[(ns,name)] → object (store.go:27-130).
+
+    Content observers fire (old, new) under the lock on every semantic
+    content change (insert, replace, delete) — resourceVersion-only bumps
+    don't notify.  Downstream incremental mirrors (the tensor snapshot)
+    hang off these, so they see exactly what the store sees, including
+    inserts that arrive through informer folds.
+    """
 
     def __init__(self):
         self._lock = threading.RLock()
         self._store: Dict[Key, APIObject] = {}
+        self._observers = []
+
+    def add_content_observer(self, fn) -> None:
+        """Registers fn(old, new) and synchronously replays the current
+        contents as (None, obj) so late-constructed mirrors see state
+        seeded before they existed (e.g. lister-seeded reservations on
+        restart)."""
+        with self._lock:
+            self._observers.append(fn)
+            snapshot = list(self._store.values())
+        for obj in snapshot:
+            try:
+                fn(None, obj)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("store observer replay failed")
+
+    def _notify(self, old: Optional[APIObject], new: Optional[APIObject]) -> None:
+        for fn in self._observers:
+            try:
+                fn(old, new)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("store observer failed")
 
     def put(self, obj: APIObject) -> None:
         """Store obj, preserving the currently-known resourceVersion: this
@@ -71,6 +104,7 @@ class ObjectStore:
             if current is not None:
                 obj.meta.resource_version = current.meta.resource_version
             self._store[key] = obj
+            self._notify(current, obj)
 
     def override_resource_version_if_newer(self, obj: APIObject) -> bool:
         """Fold an externally-observed object in: only bump our RV if the
@@ -80,6 +114,7 @@ class ObjectStore:
             current = self._store.get(key)
             if current is None:
                 self._store[key] = obj
+                self._notify(None, obj)
                 return True
             is_newer = current.meta.resource_version < obj.meta.resource_version
             if is_newer:
@@ -92,7 +127,22 @@ class ObjectStore:
             if key in self._store:
                 return False
             self._store[key] = obj
+            self._notify(None, obj)
             return True
+
+    def fold_resource_version(self, obj: APIObject) -> bool:
+        """override_resource_version_if_newer WITHOUT the insert-when-
+        absent behavior, as one atomic operation: used by the async client
+        after a successful write so a concurrent delete can never be
+        resurrected by the fold (check-then-act under the store lock)."""
+        with self._lock:
+            current = self._store.get(key_of(obj))
+            if current is None:
+                return False
+            if current.meta.resource_version < obj.meta.resource_version:
+                current.meta.resource_version = obj.meta.resource_version
+                return True
+            return False
 
     def get(self, key: Key) -> Optional[APIObject]:
         with self._lock:
@@ -100,7 +150,9 @@ class ObjectStore:
 
     def delete(self, key: Key) -> None:
         with self._lock:
-            self._store.pop(key, None)
+            old = self._store.pop(key, None)
+            if old is not None:
+                self._notify(old, None)
 
     def list(self) -> List[APIObject]:
         with self._lock:

@@ -24,7 +24,12 @@ DEMAND_WRITER_SHARDS = 5
 
 
 class ResourceReservationCache:
-    """internal/cache/resourcereservations.go:40-138."""
+    """internal/cache/resourcereservations.go:40-138.
+
+    on_change(old, new) observers fire on every local mutation and on
+    informer deletes (old/new None for create/delete) — the tensor
+    snapshot cache uses them to maintain usage deltas incrementally.
+    """
 
     def __init__(self, api: APIServer, informer: Informer, max_retry_count: int = 5):
         self._queue = ShardedUniqueQueue(RESERVATION_WRITER_SHARDS)
@@ -37,6 +42,13 @@ class ResourceReservationCache:
         self._async = AsyncClient(
             TypedClient(api, ResourceReservation.KIND), self._queue, self._store, max_retry_count
         )
+
+    def add_change_observer(self, fn) -> None:
+        """fn(old, new) on every semantic content change of the LOCAL
+        store — local writes, informer deletes, and informer inserts
+        alike (store-level observation, so incremental mirrors can never
+        drift from what reads observe)."""
+        self._store.add_content_observer(fn)
 
     def run(self) -> None:
         self._async.run()

@@ -263,6 +263,30 @@ class Harness:
     def get_resource_reservation(self, app_id: str, namespace: str = "default"):
         return self.server.resource_reservation_cache.get(namespace, app_id)
 
+    def wait_quiesced(self, timeout: float = 5.0) -> bool:
+        """Wait until async write-back queues drain and the local
+        reservation cache agrees with the API server — makes
+        timing-sensitive scenario tests deterministic (the transient
+        divergence is reference-equivalent but nondeterministic)."""
+        def rr_content(rrs):
+            return {
+                (rr.namespace, rr.name): (
+                    sorted((k, v.node) for k, v in rr.spec.reservations.items()),
+                    sorted(rr.status.pods.items()),
+                )
+                for rr in rrs
+            }
+
+        def settled():
+            if any(self.server.resource_reservation_cache.inflight_queue_lengths()):
+                return False
+            # compare full content (a popped-but-unapplied write has equal
+            # key sets but differing specs)
+            local = rr_content(self.server.resource_reservation_cache.list())
+            remote = rr_content(self.api.list("ResourceReservation"))
+            return local == remote
+        return self.wait_for_api(settled, timeout=timeout)
+
     def wait_for_api(self, cond, timeout: float = 5.0, tick: float = 0.01) -> bool:
         """waitForCondition (cmd/integration common.go:119-136)."""
         deadline = time.time() + timeout

@@ -354,3 +354,14 @@ def test_az_aware_zero_efficiency_fallback():
     expected_saz = packers.single_az_tightly_pack(zero, zero, 1, order, order, copy_metadata(metadata))
     actual_saz = TpuSingleAzBinpacker(az_aware=False)(zero, zero, 1, order, order, copy_metadata(metadata))
     assert actual_saz.has_capacity == expected_saz.has_capacity == False  # noqa: E712
+
+
+def test_multihost_mesh_shapes():
+    from k8s_spark_scheduler_tpu.parallel import mesh as meshlib
+
+    m = meshlib.make_multihost_mesh()
+    assert m.axis_names == (meshlib.NODE_AXIS,)
+    assert m.devices.size == 8  # virtual CPU mesh from conftest
+    m2 = meshlib.make_multihost_mesh(devices_per_host_axis=True)
+    assert m2.axis_names == ("hosts", meshlib.NODE_AXIS)
+    assert m2.devices.size == 8

@@ -1,0 +1,49 @@
+"""CRD lifecycle tests (reference internal/crd/utils_test.go Test_verifyCRD
+scenarios re-derived)."""
+
+import pytest
+
+from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+from k8s_spark_scheduler_tpu.kube.crd import (
+    RESOURCE_RESERVATION_CRD_NAME,
+    ensure_resource_reservations_crd,
+    resource_reservation_crd_spec,
+)
+
+
+def test_ensure_creates_when_absent():
+    api = APIServer()
+    ensure_resource_reservations_crd(api)
+    crd = api.get_crd(RESOURCE_RESERVATION_CRD_NAME)
+    assert crd is not None
+    versions = {v["name"]: v for v in crd["versions"]}
+    assert versions["v1beta2"]["storage"] and versions["v1beta2"]["served"]
+    assert versions["v1beta1"]["served"] and not versions["v1beta1"]["storage"]
+
+
+def test_ensure_upgrades_stale_spec():
+    api = APIServer()
+    stale = resource_reservation_crd_spec()
+    stale["versions"] = [{"name": "v1beta1", "served": True, "storage": True}]
+    api.create_crd(RESOURCE_RESERVATION_CRD_NAME, stale)
+    ensure_resource_reservations_crd(api)
+    crd = api.get_crd(RESOURCE_RESERVATION_CRD_NAME)
+    assert any(v["name"] == "v1beta2" and v["storage"] for v in crd["versions"])
+
+
+def test_ensure_applies_annotations():
+    api = APIServer()
+    ensure_resource_reservations_crd(api, {"team": "compute"})
+    assert api.get_crd(RESOURCE_RESERVATION_CRD_NAME)["annotations"]["team"] == "compute"
+    # equivalent spec → no-op; extra annotations respected as subset
+    ensure_resource_reservations_crd(api, {"team": "compute"})
+
+
+def test_ensure_times_out_when_never_established():
+    api = APIServer()
+    api.create_crd(RESOURCE_RESERVATION_CRD_NAME, dict(resource_reservation_crd_spec(), established=False))
+    api.set_crd_established(RESOURCE_RESERVATION_CRD_NAME, False)
+    with pytest.raises(TimeoutError):
+        ensure_resource_reservations_crd(api, timeout_seconds=0.2)
+    # failed ensure deletes the CRD (utils.go:135-150)
+    assert api.get_crd(RESOURCE_RESERVATION_CRD_NAME) is None

@@ -1,0 +1,129 @@
+"""Annotation parsing + FIFO ordering unit tests (reference
+internal/extender/sparkpods_test.go TestSparkResources / TestIsEarliest
+scenarios re-derived)."""
+
+import time
+
+import pytest
+
+from k8s_spark_scheduler_tpu.scheduler import labels as L
+from k8s_spark_scheduler_tpu.scheduler.sparkpods import (
+    AnnotationError,
+    spark_resource_usage,
+    spark_resources,
+)
+from k8s_spark_scheduler_tpu.types.objects import ObjectMeta, Pod
+from k8s_spark_scheduler_tpu.types.resources import Resources
+
+
+def pod_with(annotations):
+    return Pod(meta=ObjectMeta(name="drv", annotations=annotations))
+
+
+BASE = {
+    L.DRIVER_CPU: "1",
+    L.DRIVER_MEMORY: "1Gi",
+    L.EXECUTOR_CPU: "2",
+    L.EXECUTOR_MEMORY: "4Gi",
+    L.EXECUTOR_COUNT: "8",
+}
+
+
+def test_static_allocation_parsing():
+    r = spark_resources(pod_with(BASE))
+    assert r.driver_resources.eq(Resources.of("1", "1Gi"))
+    assert r.executor_resources.eq(Resources.of("2", "4Gi"))
+    assert r.min_executor_count == r.max_executor_count == 8
+
+
+def test_gpu_annotations_optional():
+    r = spark_resources(pod_with(BASE))
+    assert r.driver_resources.nvidia_gpu.is_zero()
+    with_gpu = dict(BASE, **{L.DRIVER_NVIDIA_GPUS: "1", L.EXECUTOR_NVIDIA_GPUS: "2"})
+    r = spark_resources(pod_with(with_gpu))
+    assert r.driver_resources.nvidia_gpu.value() == 1
+    assert r.executor_resources.nvidia_gpu.value() == 2
+
+
+def test_dynamic_allocation_parsing():
+    da = dict(BASE)
+    del da[L.EXECUTOR_COUNT]
+    da[L.DYNAMIC_ALLOCATION_ENABLED] = "true"
+    da[L.DA_MIN_EXECUTOR_COUNT] = "2"
+    da[L.DA_MAX_EXECUTOR_COUNT] = "10"
+    r = spark_resources(pod_with(da))
+    assert r.min_executor_count == 2 and r.max_executor_count == 10
+
+
+def test_da_ignores_executor_count_annotation():
+    da = dict(BASE)  # keeps EXECUTOR_COUNT: 8, which DA must ignore
+    da[L.DYNAMIC_ALLOCATION_ENABLED] = "true"
+    da[L.DA_MIN_EXECUTOR_COUNT] = "1"
+    da[L.DA_MAX_EXECUTOR_COUNT] = "3"
+    r = spark_resources(pod_with(da))
+    assert (r.min_executor_count, r.max_executor_count) == (1, 3)
+
+
+@pytest.mark.parametrize(
+    "mutate,needle",
+    [
+        (lambda a: a.pop(L.EXECUTOR_COUNT), "ExecutorCount is required"),
+        (lambda a: a.pop(L.DRIVER_CPU), "missing from driver"),
+        (lambda a: a.pop(L.EXECUTOR_MEMORY), "missing from driver"),
+        (lambda a: a.update({L.DRIVER_CPU: "wat"}), "parseable"),
+        (lambda a: a.update({L.DYNAMIC_ALLOCATION_ENABLED: "maybe"}), "boolean"),
+    ],
+)
+def test_parse_errors(mutate, needle):
+    annotations = dict(BASE)
+    mutate(annotations)
+    with pytest.raises(AnnotationError, match=needle):
+        spark_resources(pod_with(annotations))
+
+
+def test_da_requires_min_max():
+    da = dict(BASE)
+    da[L.DYNAMIC_ALLOCATION_ENABLED] = "true"
+    with pytest.raises(AnnotationError, match="required when DynamicAllocationEnabled"):
+        spark_resources(pod_with(da))
+
+
+def test_usage_overwrite_quirk():
+    # sparkpods.go:139-146: assignment, not accumulation
+    usage = spark_resource_usage(
+        Resources.of(4, "4Gi"), Resources.of(1, "1Gi"), "n1", ["n1", "n2", "n2"]
+    )
+    assert usage["n1"].eq(Resources.of(1, "1Gi"))  # executor overwrote driver
+    assert usage["n2"].eq(Resources.of(1, "1Gi"))  # one executor's worth, not two
+
+
+def test_list_earlier_drivers_ordering():
+    from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+    from k8s_spark_scheduler_tpu.kube.informer import InformerFactory
+    from k8s_spark_scheduler_tpu.scheduler.sparkpods import SparkPodLister
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+    api = APIServer()
+    factory = InformerFactory(api)
+    informer = factory.informer("Pod")
+    informer.start()
+    lister = SparkPodLister(informer, "resource_channel")
+
+    t0 = time.time()
+    target = Harness.static_allocation_spark_pods("target", 1, creation_timestamp=t0)[0]
+    api.create(target)
+    older1 = Harness.static_allocation_spark_pods("older1", 1, creation_timestamp=t0 - 50)[0]
+    older2 = Harness.static_allocation_spark_pods("older2", 1, creation_timestamp=t0 - 100)[0]
+    newer = Harness.static_allocation_spark_pods("newer", 1, creation_timestamp=t0 + 50)[0]
+    other_group = Harness.static_allocation_spark_pods(
+        "othergroup", 1, creation_timestamp=t0 - 200, instance_group="different"
+    )[0]
+    scheduled = Harness.static_allocation_spark_pods("done", 1, creation_timestamp=t0 - 300)[0]
+    scheduled.node_name = "n1"
+    for p in (older1, older2, newer, other_group, scheduled):
+        api.create(p)
+
+    earlier = lister.list_earlier_drivers(target)
+    # sorted oldest first; excludes newer, other instance groups, and
+    # already-scheduled drivers
+    assert [p.name for p in earlier] == [older2.name, older1.name]

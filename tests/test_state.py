@@ -288,3 +288,22 @@ def test_informer_delete_removes_from_cache():
         assert _wait_for(lambda: cache.get("default", "app-1") is None)
     finally:
         cache.stop()
+
+
+def test_store_observer_replays_existing_content():
+    s = ObjectStore()
+    s.put_if_absent(rr("pre"))
+    seen = []
+    s.add_content_observer(lambda old, new: seen.append((old, new and new.name)))
+    assert seen == [(None, "pre")]
+
+
+def test_fold_resource_version_never_resurrects():
+    s = ObjectStore()
+    obj = rr("a")
+    obj.meta.resource_version = 9
+    assert not s.fold_resource_version(obj)  # absent → no insert
+    assert s.get(("default", "a")) is None
+    s.put_if_absent(rr("a"))
+    assert s.fold_resource_version(obj)
+    assert s.get(("default", "a")).meta.resource_version == 9
