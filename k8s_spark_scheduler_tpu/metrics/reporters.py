@@ -42,6 +42,22 @@ class ReporterSet:
         self._tick = tick_seconds
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # informer delay (informer.go:33-51): event-delivery lag of fresh
+        # pod adds, sampled per tick
+        self._delays: List[float] = []
+        self._delay_lock = threading.Lock()
+        server.pod_informer.add_event_handler(on_add=self._sample_informer_delay)
+
+    def _sample_informer_delay(self, pod) -> None:
+        created = pod.creation_timestamp
+        if not created:
+            return
+        lag = max(time.time() - created, 0.0)
+        if lag < 300.0:  # only fresh pods are a meaningful delay signal
+            with self._delay_lock:
+                self._delays.append(lag)
+                if len(self._delays) > 4096:
+                    del self._delays[:2048]
 
     @property
     def metrics(self) -> MetricsRegistry:
@@ -72,6 +88,7 @@ class ReporterSet:
             self.report_unbound_reservations,
             self.report_soft_reservations,
             self.report_queue_depths,
+            self.report_informer_delay,
         ):
             try:
                 fn()
@@ -180,6 +197,14 @@ class ReporterSet:
             ):
                 count += 1
         self.metrics.gauge(names.EXECUTORS_WITH_NO_RESERVATION_COUNT, float(count))
+
+    def report_informer_delay(self) -> None:
+        with self._delay_lock:
+            delays, self._delays = self._delays, []
+        if delays:
+            delays.sort()
+            self.metrics.gauge(names.POD_INFORMER_DELAY, _percentile(delays, 0.5))
+            self.metrics.gauge(names.POD_INFORMER_DELAY + ".max", delays[-1])
 
     # -- queue depths -------------------------------------------------------
 

@@ -142,14 +142,14 @@ class SparkSchedulerExtender:
         try:
             node_name, outcome = self._select_node(instance_group, role, pod, args.node_names)
         except SchedulingFailure as err:
-            self._mark_schedule(instance_group, role, err.outcome, t0)
+            self._mark_schedule(instance_group, role, err.outcome, t0, pod)
             if err.outcome == FAILURE_INTERNAL:
                 logger.exception("internal error scheduling pod %s", pod.name)
             else:
                 logger.info("failed to schedule pod %s: %s (%s)", pod.name, err, err.outcome)
             return self._fail_with_message(err.outcome, args, str(err))
 
-        self._mark_schedule(instance_group, role, outcome, t0)
+        self._mark_schedule(instance_group, role, outcome, t0, pod)
 
         if role == L.DRIVER:
             try:
@@ -172,16 +172,44 @@ class SparkSchedulerExtender:
         logger.info("scheduling pod %s to node %s", pod.name, node_name)
         return ExtenderFilterResult(node_names=[node_name])
 
-    def _mark_schedule(self, instance_group: str, role: str, outcome: str, t0: float) -> None:
-        self._metrics.histogram(
-            "foundry.spark.scheduler.schedule.time",
-            time.perf_counter() - t0,
-            {"instanceGroup": instance_group, "role": role, "outcome": outcome},
-        )
-        self._metrics.counter(
-            "foundry.spark.scheduler.schedule.outcome",
-            {"instanceGroup": instance_group, "role": role, "outcome": outcome},
-        )
+    def _mark_schedule(
+        self, instance_group: str, role: str, outcome: str, t0: float, pod: Pod = None
+    ) -> None:
+        """ScheduleTimer semantics (metrics.go:164-219): the retry tag is
+        derived statelessly from the pod's PodScheduled condition, the
+        last-seen time from that condition's transition time, and the
+        first-sight slow log fires only on first tries."""
+        from ..metrics import names as mnames
+
+        tags = {"instanceGroup": instance_group, "role": role, "outcome": outcome}
+        self._metrics.histogram(mnames.SCHEDULING_PROCESSING_TIME, time.perf_counter() - t0, tags)
+        self._metrics.counter(mnames.REQUEST_COUNTER, tags)
+        if pod is not None:
+            now = time.time()
+            created = pod.creation_timestamp or now
+            scheduled_condition = pod.conditions.get("PodScheduled")
+            is_retry = scheduled_condition is not None
+            last_seen = (
+                scheduled_condition.transition_time
+                if is_retry and scheduled_condition.transition_time
+                else created
+            )
+            wait = max(now - created, 0.0)
+            self._metrics.histogram(mnames.SCHEDULING_WAIT_TIME, wait, tags)
+            self._metrics.histogram(
+                mnames.SCHEDULING_RETRY_TIME,
+                max(now - last_seen, 0.0),
+                dict(tags, retry="true" if is_retry else "false"),
+            )
+            if wait > mnames.SLOW_LOG_THRESHOLD_SECONDS and not is_retry:
+                logger.warning(
+                    "pod %s/%s first seen by the extender but older than the slow "
+                    "log threshold (%.0fs, outcome %s)",
+                    pod.namespace,
+                    pod.name,
+                    wait,
+                    outcome,
+                )
 
     def _fail_with_message(self, outcome: str, args: ExtenderArgs, message: str) -> ExtenderFilterResult:
         if self._waste_reporter is not None:
@@ -192,9 +220,14 @@ class SparkSchedulerExtender:
         """resource.go:194-205."""
         now = time.time()
         if now > self._last_request + LEADER_ELECTION_INTERVAL_SECONDS:
+            from ..metrics import names as mnames
             from .failover import sync_resource_reservations_and_demands
 
+            t0 = time.perf_counter()
             sync_resource_reservations_and_demands(self)
+            self._metrics.histogram(
+                mnames.RECONCILIATION_TIME, time.perf_counter() - t0
+            )
         self._last_request = now
 
     def _select_node(

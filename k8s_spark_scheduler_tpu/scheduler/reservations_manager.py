@@ -28,6 +28,9 @@ from .sparkpods import SparkApplicationResources, SparkPodLister, spark_resource
 
 logger = logging.getLogger(__name__)
 
+# slow time-to-first-bind log threshold (resourcereservations.go:42-44)
+SLOW_LOG_DURATION_SECONDS = 120.0
+
 DRIVER_RESERVATION_NAME = "driver"
 
 
@@ -74,9 +77,13 @@ class ResourceReservationManager:
         soft_reservation_store: SoftReservationStore,
         pod_lister: SparkPodLister,
         pod_informer: Informer,
+        metrics=None,
     ):
+        from ..metrics.registry import default_registry
+
         self._resource_reservations = resource_reservations
         self._soft_reservations = soft_reservation_store
+        self._metrics = metrics if metrics is not None else default_registry
         self._pod_lister = pod_lister
         self._mutex = threading.RLock()
         self._da_compaction_apps: Dict[str, str] = {}  # appID → namespace
@@ -275,8 +282,32 @@ class ResourceReservationManager:
         copy_rr = rr.deepcopy()
         reservation = copy_rr.spec.reservations[reservation_name]
         reservation.node = node
+        first_bind = reservation_name not in rr.status.pods
         copy_rr.status.pods[reservation_name] = executor.name
         self._resource_reservations.update(copy_rr)
+
+        # time-to-first-bind metric + slow log, only on the reservation's
+        # first binding (resourcereservations.go:364-387)
+        if first_bind and rr.meta.creation_timestamp:
+            import time as _time
+
+            from ..metrics import names as mnames
+
+            duration = _time.time() - rr.meta.creation_timestamp
+            self._metrics.histogram(mnames.TIME_TO_FIRST_BIND, duration)
+            snap = self._metrics.get_histogram(mnames.TIME_TO_FIRST_BIND)
+            self._metrics.gauge(mnames.TIME_TO_FIRST_BIND_MEDIAN, snap["p50"])
+            self._metrics.gauge(mnames.TIME_TO_FIRST_BIND_MEAN, snap["mean"])
+            if duration > SLOW_LOG_DURATION_SECONDS:
+                logger.warning(
+                    "time to first executor bind above threshold: "
+                    "duration=%.0fs appID=%s node=%s executor=%s reservation=%s",
+                    duration,
+                    rr.labels.get(L.SPARK_APP_ID_LABEL, ""),
+                    node,
+                    executor.name,
+                    reservation_name,
+                )
 
     def _bind_executor_to_soft_reservation(self, executor: Pod, node: str) -> None:
         """resourcereservations.go:391-409."""

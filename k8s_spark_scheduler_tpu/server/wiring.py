@@ -101,14 +101,21 @@ def init_server_with_clients(
     rr_informer = factory.informer(ResourceReservation.KIND)
     factory.start()
 
-    # caches (cmd/server.go:129-155)
+    # caches (cmd/server.go:129-155); one shared write-rate bucket per
+    # process, like the kube clientsets' QPS/Burst (cmd/clients.go:53-54)
+    from ..kube.ratelimit import TokenBucket
+
+    rate_bucket = TokenBucket(install.qps, install.burst) if install.qps > 0 else None
     rr_cache = ResourceReservationCache(
-        api, rr_informer, install.async_client.max_retry_count
+        api, rr_informer, install.async_client.max_retry_count, rate_bucket=rate_bucket
     )
     lazy_demand_informer = LazyDemandInformer(api, factory, poll_interval=demand_poll_interval)
     binpacker = select_binpacker(install.binpack_algo)
     demand_cache = SafeDemandCache(
-        lazy_demand_informer, api, install.async_client.max_retry_count
+        lazy_demand_informer,
+        api,
+        install.async_client.max_retry_count,
+        rate_bucket=rate_bucket,
     )
     demand_manager = DemandManager(
         demand_cache, binpacker, install.instance_group_label, event_log
@@ -118,7 +125,7 @@ def init_server_with_clients(
     # stores + managers (cmd/server.go:157-167)
     soft_store = SoftReservationStore(pod_informer)
     pod_lister = SparkPodLister(pod_informer, install.instance_group_label)
-    rrm = ResourceReservationManager(rr_cache, soft_store, pod_lister, pod_informer)
+    rrm = ResourceReservationManager(rr_cache, soft_store, pod_lister, pod_informer, metrics=metrics)
     overhead = OverheadComputer(pod_informer, rrm)
 
     # event-driven integer snapshot for the tpu-batch fast path

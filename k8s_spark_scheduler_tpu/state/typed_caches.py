@@ -31,7 +31,9 @@ class ResourceReservationCache:
     snapshot cache uses them to maintain usage deltas incrementally.
     """
 
-    def __init__(self, api: APIServer, informer: Informer, max_retry_count: int = 5):
+    def __init__(
+        self, api: APIServer, informer: Informer, max_retry_count: int = 5, rate_bucket=None
+    ):
         self._queue = ShardedUniqueQueue(RESERVATION_WRITER_SHARDS)
         self._store = ObjectStore()
         # seed from the lister so state survives restarts
@@ -39,9 +41,12 @@ class ResourceReservationCache:
         for obj in informer.list():
             self._store.put_if_absent(obj)
         self._cache = WriteBackCache(self._queue, self._store, informer)
-        self._async = AsyncClient(
-            TypedClient(api, ResourceReservation.KIND), self._queue, self._store, max_retry_count
-        )
+        client = TypedClient(api, ResourceReservation.KIND)
+        if rate_bucket is not None:
+            from ..kube.ratelimit import RateLimitedClient
+
+            client = RateLimitedClient(client, rate_bucket)
+        self._async = AsyncClient(client, self._queue, self._store, max_retry_count)
 
     def add_change_observer(self, fn) -> None:
         """fn(old, new) on every semantic content change of the LOCAL
@@ -78,15 +83,20 @@ class ResourceReservationCache:
 class DemandCache:
     """internal/cache/demands.go:40-117."""
 
-    def __init__(self, api: APIServer, informer: Informer, max_retry_count: int = 5):
+    def __init__(
+        self, api: APIServer, informer: Informer, max_retry_count: int = 5, rate_bucket=None
+    ):
         self._queue = ShardedUniqueQueue(DEMAND_WRITER_SHARDS)
         self._store = ObjectStore()
         for obj in informer.list():
             self._store.put_if_absent(obj)
         self._cache = WriteBackCache(self._queue, self._store, informer)
-        self._async = AsyncClient(
-            TypedClient(api, Demand.KIND), self._queue, self._store, max_retry_count
-        )
+        client = TypedClient(api, Demand.KIND)
+        if rate_bucket is not None:
+            from ..kube.ratelimit import RateLimitedClient
+
+            client = RateLimitedClient(client, rate_bucket)
+        self._async = AsyncClient(client, self._queue, self._store, max_retry_count)
 
     def run(self) -> None:
         self._async.run()
@@ -186,10 +196,17 @@ class SafeDemandCache:
     """internal/cache/safedemands.go:31-127: degrades to a no-op until the
     Demand CRD exists."""
 
-    def __init__(self, lazy_informer: LazyDemandInformer, api: APIServer, max_retry_count: int = 5):
+    def __init__(
+        self,
+        lazy_informer: LazyDemandInformer,
+        api: APIServer,
+        max_retry_count: int = 5,
+        rate_bucket=None,
+    ):
         self._lazy = lazy_informer
         self._api = api
         self._max_retry_count = max_retry_count
+        self._rate_bucket = rate_bucket
         self._delegate: Optional[DemandCache] = None
         self._lock = threading.Lock()
         lazy_informer.on_ready(self._construct)
@@ -197,7 +214,12 @@ class SafeDemandCache:
     def _construct(self) -> None:
         with self._lock:
             if self._delegate is None:
-                cache = DemandCache(self._api, self._lazy.informer(), self._max_retry_count)
+                cache = DemandCache(
+                    self._api,
+                    self._lazy.informer(),
+                    self._max_retry_count,
+                    rate_bucket=self._rate_bucket,
+                )
                 cache.run()
                 self._delegate = cache
 

@@ -56,7 +56,7 @@ def test_schedule_outcome_metrics(harness):
     m = harness.server.metrics
     assert (
         m.get_counter(
-            "foundry.spark.scheduler.schedule.outcome",
+            names.REQUEST_COUNTER,
             {"instanceGroup": "batch-medium-priority", "role": "driver", "outcome": "success"},
         )
         == 1.0
@@ -106,3 +106,22 @@ def test_registry_timer_and_snapshot():
     snap = m.snapshot()
     assert any(k.startswith("op.time") for k in snap["histograms"])
     assert m.get_histogram("op.time", {"t": "x"})["count"] == 1
+
+
+def test_time_to_first_bind_metric(harness):
+    m = harness.server.metrics
+    harness.new_node("n1")
+    harness.new_node("n2")
+    before = m.get_histogram(names.TIME_TO_FIRST_BIND)["count"]
+    pods = harness.static_allocation_spark_pods("app-ttfb", 1)
+    harness.assert_success(harness.schedule(pods[0], ["n1", "n2"]))
+    harness.assert_success(harness.schedule(pods[1], ["n1", "n2"]))
+    after = m.get_histogram(names.TIME_TO_FIRST_BIND)["count"]
+    assert after == before + 1
+    assert m.get_gauge(names.TIME_TO_FIRST_BIND_MEDIAN) is not None
+    # a rebind of the same reservation must not re-count
+    harness.terminate_pod(pods[1])
+    replacement = harness.static_allocation_spark_pods("app-ttfb", 1)[1]
+    replacement.meta.name = "app-ttfb-exec-r"
+    harness.assert_success(harness.schedule(replacement, ["n1", "n2"]))
+    assert m.get_histogram(names.TIME_TO_FIRST_BIND)["count"] == after

@@ -307,3 +307,24 @@ def test_fold_resource_version_never_resurrects():
     s.put_if_absent(rr("a"))
     assert s.fold_resource_version(obj)
     assert s.get(("default", "a")).meta.resource_version == 9
+
+
+def test_rate_limited_writes():
+    from k8s_spark_scheduler_tpu.kube.ratelimit import TokenBucket
+
+    api = APIServer()
+    factory = InformerFactory(api)
+    informer = factory.informer("ResourceReservation")
+    informer.start()
+    # 20 writes/s with burst 2: 10 creates should take roughly >= 350ms
+    cache = ResourceReservationCache(api, informer, rate_bucket=TokenBucket(20, 2))
+    cache.run()
+    try:
+        t0 = time.time()
+        for i in range(10):
+            cache.create(rr(f"rl-{i}"))
+        assert _wait_for(lambda: len(api.list("ResourceReservation")) == 10)
+        elapsed = time.time() - t0
+        assert elapsed >= 0.3, f"writes were not rate limited ({elapsed:.3f}s)"
+    finally:
+        cache.stop()
