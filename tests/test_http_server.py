@@ -54,18 +54,11 @@ def served():
     scheduler.stop()
 
 
-def _driver_pod_json(app_id="app-http", executors=2):
-    pods = Harness.static_allocation_spark_pods(app_id, executors)
-    return serde.pod_to_dict(pods[0]), [serde.pod_to_dict(p) for p in pods[1:]]
-
-
-def test_predicates_end_to_end(served):
-    api, scheduler, http = served
-    # create nodes directly on the shared api server
+def _create_nodes(api, count=2):
     from k8s_spark_scheduler_tpu.types.objects import Node, ObjectMeta
     from k8s_spark_scheduler_tpu.types.resources import Resources, ZONE_LABEL
 
-    for i in range(2):
+    for i in range(count):
         api.create(
             Node(
                 meta=ObjectMeta(
@@ -75,6 +68,16 @@ def test_predicates_end_to_end(served):
                 allocatable=Resources.of("8", "8Gi", "1"),
             )
         )
+
+
+def _driver_pod_json(app_id="app-http", executors=2):
+    pods = Harness.static_allocation_spark_pods(app_id, executors)
+    return serde.pod_to_dict(pods[0]), [serde.pod_to_dict(p) for p in pods[1:]]
+
+
+def test_predicates_end_to_end(served):
+    api, scheduler, http = served
+    _create_nodes(api)
 
     driver_json, exec_jsons = _driver_pod_json()
     # the driver pod exists in the cluster before kube-scheduler calls us
@@ -194,3 +197,62 @@ def test_cli_version():
     from k8s_spark_scheduler_tpu.server.__main__ import main
 
     assert main(["--version"]) == 0
+
+
+def test_static_compaction_integration(served):
+    """cmd/integration/server_test.go:41 Test_StaticCompaction: a
+    pre-existing reservation whose executor pod is gone plus an
+    out-of-band-scheduled replacement; the first Predicate after idle
+    reconciles and the ASYNC write-back visibly patches the RR at the
+    API server (polled, like waitForCondition common.go:119-136)."""
+    api, scheduler, http = served
+    from k8s_spark_scheduler_tpu.scheduler.extender import (
+        LEADER_ELECTION_INTERVAL_SECONDS,
+    )
+    from k8s_spark_scheduler_tpu.scheduler.reservations_manager import (
+        new_resource_reservation,
+    )
+    from k8s_spark_scheduler_tpu.types.objects import PodPhase
+    from k8s_spark_scheduler_tpu.types.resources import Resources
+
+    _create_nodes(api)
+
+    # pre-existing state: driver + one executor reservation, but the
+    # executor named in status is long dead and a NEW executor pod was
+    # scheduled out of band (by the previous leader)
+    pods = Harness.static_allocation_spark_pods("app-compact", 1)
+    driver, executor = pods
+    driver.node_name = "n0"
+    driver.phase = PodPhase.RUNNING
+    created_driver = api.create(driver)
+
+    rr = new_resource_reservation(
+        "n0", ["n1"], created_driver, Resources.of("1", "1Gi"), Resources.of("1", "1Gi")
+    )
+    rr.status.pods["executor-1"] = "long-gone-executor"
+    api.create(rr)
+
+    executor.node_name = "n1"
+    executor.phase = PodPhase.RUNNING
+    api.create(executor)
+
+    # force the idle-reconcile path on the next request
+    scheduler.extender._last_request = (
+        time.time() - LEADER_ELECTION_INTERVAL_SECONDS - 1
+    )
+    probe = Harness.static_allocation_spark_pods("probe-app", 0)[0]
+    api.create(serde.pod_from_dict(serde.pod_to_dict(probe)))
+    status, _ = _post(
+        http.port, "/predicates", {"Pod": serde.pod_to_dict(probe), "NodeNames": ["n0", "n1"]}
+    )
+    assert status == 200
+
+    # the reconciler claims the orphan executor onto the stale reservation
+    # and the async client patches the API server visibly
+    deadline = time.time() + 5
+    patched = False
+    while time.time() < deadline and not patched:
+        server_rr = api.get("ResourceReservation", "default", "app-compact")
+        patched = server_rr.status.pods.get("executor-1") == executor.name
+        time.sleep(0.01)
+    assert patched, server_rr.status.pods
