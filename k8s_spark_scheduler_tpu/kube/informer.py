@@ -32,11 +32,16 @@ class Informer:
     # (guards against a late stale MODIFIED resurrecting a deleted object)
     _TOMBSTONE_LIMIT = 16384
 
-    def __init__(self, api: APIServer, kind: str):
+    def __init__(self, api: APIServer, kind: str, index_labels: Tuple[str, ...] = ()):
         self._api = api
         self.kind = kind
         self._lock = threading.RLock()
         self._store: Dict[Tuple[str, str], APIObject] = {}
+        # secondary indexes: label key → label value → set of store keys;
+        # turns the reference's O(all pods) label-selector scans
+        # (client-go listers re-filter on every call) into O(result)
+        self._index_labels = tuple(index_labels)
+        self._indexes: Dict[str, Dict[str, set]] = {k: {} for k in self._index_labels}
         # key → highest resourceVersion ever delivered; events are globally
         # ordered by rv at the server, so delivery races are filtered here
         self._last_rv: Dict[Tuple[str, str], int] = {}
@@ -71,6 +76,19 @@ class Informer:
                 self._store.pop(key, None)
             else:
                 self._store[key] = obj
+            for label_key, index in self._indexes.items():
+                if old is not None:
+                    old_value = old.labels.get(label_key)
+                    if old_value is not None:
+                        bucket = index.get(old_value)
+                        if bucket is not None:
+                            bucket.discard(key)
+                            if not bucket:
+                                del index[old_value]
+                if event != DELETED:
+                    value = obj.labels.get(label_key)
+                    if value is not None:
+                        index.setdefault(value, set()).add(key)
             add_handlers = list(self._add_handlers)
             update_handlers = list(self._update_handlers)
             delete_handlers = list(self._delete_handlers)
@@ -131,9 +149,18 @@ class Informer:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[APIObject]:
         with self._lock:
+            # serve from a secondary index when one covers the selector
+            candidates = None
+            if label_selector:
+                for k, v in label_selector.items():
+                    if k in self._indexes:
+                        keys = self._indexes[k].get(v, set())
+                        candidates = [self._store[key] for key in keys if key in self._store]
+                        break
+            pool = candidates if candidates is not None else self._store.values()
             out = []
-            for (ns, _), obj in self._store.items():
-                if namespace is not None and ns != namespace:
+            for obj in pool:
+                if namespace is not None and obj.namespace != namespace:
                     continue
                 if label_selector and any(
                     obj.labels.get(k) != v for k, v in label_selector.items()
@@ -160,12 +187,18 @@ class InformerFactory:
         self._informers: Dict[str, Informer] = {}
         self._lock = threading.Lock()
 
-    def informer(self, kind: str) -> Informer:
+    def informer(self, kind: str, index_labels: Tuple[str, ...] = ()) -> Informer:
         with self._lock:
             inf = self._informers.get(kind)
             if inf is None:
-                inf = Informer(self._api, kind)
+                inf = Informer(self._api, kind, index_labels=index_labels)
                 self._informers[kind] = inf
+            elif index_labels and set(index_labels) - set(inf._index_labels):
+                raise ValueError(
+                    f"informer for {kind} already created without indexes "
+                    f"{set(index_labels) - set(inf._index_labels)}; create the "
+                    "indexed informer first"
+                )
             return inf
 
     def start(self) -> None:

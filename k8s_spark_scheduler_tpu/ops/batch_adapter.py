@@ -83,6 +83,25 @@ def build_reserved(
     return reserved
 
 
+def minimal_fragmentation_assignment(
+    names: List[str], cap: np.ndarray, k: int
+) -> Optional[List[str]]:
+    """Exact minimal-fragmentation placement from per-node integer
+    capacities (minimal_fragmentation.go:59-137): the capacities the
+    device returns equal the oracle's Fraction floor divisions, so the
+    host-side bisect algorithm reproduces the oracle list exactly."""
+    from .capacity import NodeAndExecutorCapacity
+    from .packers import minimal_fragmentation_from_capacities
+
+    if k == 0:
+        return []
+    capacities = [
+        NodeAndExecutorCapacity(name, int(c)) for name, c in zip(names, cap) if c > 0
+    ]
+    nodes, ok = minimal_fragmentation_from_capacities(k, capacities)
+    return nodes if ok else None
+
+
 def counts_to_tightly_list(names: List[str], counts: np.ndarray) -> List[str]:
     out: List[str] = []
     for name, c in zip(names, counts):
@@ -136,11 +155,10 @@ class TpuBatchBinpacker:
             [app_resources_of(driver_resources, executor_resources, executor_count)]
         )
         problem = scale_problem(cluster, apps)
-        oracle = (
-            packers.tightly_pack
-            if self.assignment_policy == "tightly-pack"
-            else packers.distribute_evenly
-        )
+        oracle = {
+            "tightly-pack": packers.tightly_pack,
+            "minimal-fragmentation": packers.minimal_fragmentation_pack,
+        }.get(self.assignment_policy, packers.distribute_evenly)
         if not problem.ok:
             logger.warning("snapshot not exactly tensorizable; using host oracle")
             return oracle(
@@ -209,6 +227,28 @@ class TpuBatchBinpacker:
         if self.assignment_policy == "tightly-pack":
             counts = np.asarray(solve.exec_counts)[: len(names)]
             executor_nodes = counts_to_tightly_list(names, counts)
+        elif self.assignment_policy == "minimal-fragmentation":
+            # min-frag's (k+max)/2 subset threshold needs UNCLAMPED
+            # capacities (the device clamps to k for overflow safety):
+            # recompute exactly from the scaled integer rows, with the
+            # driver subtracted on its node
+            avail = problem.avail[: len(names)].astype(np.int64).copy()
+            avail[driver_idx] -= problem.driver[0].astype(np.int64)
+            exec_row = problem.executor[0].astype(np.int64)
+            per_dim = np.where(
+                exec_row[None, :] == 0,
+                np.int64(2**62),
+                np.floor_divide(avail, np.maximum(exec_row[None, :], 1)),
+            )
+            cap = np.clip(per_dim.min(axis=1), 0, None)
+            cap = np.where(np.asarray(problem.exec_ok[: len(names)]), cap, 0)
+            executor_nodes = minimal_fragmentation_assignment(names, cap, executor_count)
+            if executor_nodes is None:
+                return empty_packing_result()
+            # the reference's min-frag does NOT fold executor placements
+            # into reserved for efficiency (packers.minimal_fragmentation
+            # QUIRK) — efficiency accounting sees only the driver
+            counts = np.zeros(len(names), dtype=np.int64)
         else:
             cap = np.asarray(solve.exec_capacity)[: len(names)]
             counts = evenly_counts(cap, executor_count)
@@ -257,6 +297,14 @@ def tpu_batch_binpacker() -> Binpacker:
         binpack_func=TpuBatchBinpacker(assignment_policy="tightly-pack"),
         is_single_az=False,
         queue_solver=TpuFifoSolver(assignment_policy="tightly-pack"),
+    )
+
+
+def tpu_batch_min_frag_binpacker() -> Binpacker:
+    return Binpacker(
+        name="tpu-batch-minimal-fragmentation",
+        binpack_func=TpuBatchBinpacker(assignment_policy="minimal-fragmentation"),
+        is_single_az=False,
     )
 
 

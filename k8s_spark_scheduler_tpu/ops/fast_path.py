@@ -52,15 +52,10 @@ def build_cluster_tensor(
         )
         return empty, {}
 
-    # required node affinity + nodeSelector filter (metadata membership)
+    # required node affinity + nodeSelector filter (metadata membership),
+    # via the same matcher the slow path uses
     eligible = np.fromiter(
-        (
-            all(labels.get(k) == v for k, v in driver_pod.node_selector.items())
-            and all(
-                labels.get(k) in values for k, values in driver_pod.node_affinity.items()
-            )
-            for labels in snap.labels
-        ),
+        (driver_pod.matches_labels(labels) for labels in snap.labels),
         dtype=bool,
         count=n,
     )

@@ -167,10 +167,19 @@ def minimal_fragmentation(
         node_priority_order, metadata, reserved_resources, executor_resources
     )
     capacities = cap.filter_out_nodes_without_capacity(capacities)
+    return minimal_fragmentation_from_capacities(executor_count, capacities)
+
+
+def minimal_fragmentation_from_capacities(
+    executor_count: int, capacities: List[cap.NodeAndExecutorCapacity]
+) -> Tuple[Optional[List[str]], bool]:
+    """The capacity-driven core of minimal_fragmentation.go:71-94, shared
+    by the oracle and the device decode (bit-identical is a parity
+    requirement)."""
     if not capacities:
         return None, False
 
-    capacities.sort(key=lambda c: c.capacity)  # stable, ascending
+    capacities = sorted(capacities, key=lambda c: c.capacity)  # stable, ascending
     max_capacity = capacities[-1].capacity
     if executor_count < max_capacity:
         target_capacity = (executor_count + max_capacity) // 2

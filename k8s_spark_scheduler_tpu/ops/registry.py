@@ -22,6 +22,7 @@ MINIMAL_FRAGMENTATION = "minimal-fragmentation"
 TPU_BATCH = "tpu-batch"
 TPU_BATCH_SINGLE_AZ = "tpu-batch-single-az"
 TPU_BATCH_AZ_AWARE = "tpu-batch-az-aware"
+TPU_BATCH_MIN_FRAG = "tpu-batch-minimal-fragmentation"
 
 DEFAULT = DISTRIBUTE_EVENLY
 
@@ -53,15 +54,18 @@ register(MINIMAL_FRAGMENTATION, packers.minimal_fragmentation_pack, False)
 
 def select_binpacker(name: str) -> Binpacker:
     """binpack.go:52-58; unknown → distribute-evenly."""
-    if name in (TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE):
+    if name in (TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE, TPU_BATCH_MIN_FRAG):
         try:
             # imported lazily: pulls in jax
             from .batch_adapter import (
                 tpu_batch_az_aware_binpacker,
                 tpu_batch_binpacker,
+                tpu_batch_min_frag_binpacker,
                 tpu_batch_single_az_binpacker,
             )
 
+            if name == TPU_BATCH_MIN_FRAG:
+                return tpu_batch_min_frag_binpacker()
             if name == TPU_BATCH_SINGLE_AZ:
                 return tpu_batch_single_az_binpacker()
             if name == TPU_BATCH_AZ_AWARE:
@@ -74,6 +78,7 @@ def select_binpacker(name: str) -> Binpacker:
                 TPU_BATCH: TIGHTLY_PACK,
                 TPU_BATCH_SINGLE_AZ: SINGLE_AZ_TIGHTLY_PACK,
                 TPU_BATCH_AZ_AWARE: AZ_AWARE_TIGHTLY_PACK,
+                TPU_BATCH_MIN_FRAG: MINIMAL_FRAGMENTATION,
             }[name]
             logging.getLogger(__name__).error(
                 "binpack %r configured but the JAX batch solver could not be "
@@ -87,4 +92,7 @@ def select_binpacker(name: str) -> Binpacker:
 
 
 def available_binpackers() -> list[str]:
-    return sorted(_REGISTRY.keys() | {TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE})
+    return sorted(
+        _REGISTRY.keys()
+        | {TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE, TPU_BATCH_MIN_FRAG}
+    )

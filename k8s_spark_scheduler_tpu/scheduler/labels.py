@@ -56,6 +56,10 @@ def find_instance_group_from_pod_spec(pod: Pod, instance_group_label: str) -> Tu
     values = pod.node_affinity.get(instance_group_label)
     if values:
         return values[0], True
+    for term in pod.affinity_terms:
+        for key, operator, term_values in term:
+            if key == instance_group_label and operator == "In" and term_values:
+                return term_values[0], True
     return "", False
 
 

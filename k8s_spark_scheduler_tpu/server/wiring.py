@@ -96,7 +96,9 @@ def init_server_with_clients(
 
     # informer factories + sync (cmd/server.go:91-127)
     factory = InformerFactory(api)
-    pod_informer = factory.informer(Pod.KIND)
+    pod_informer = factory.informer(
+        Pod.KIND, index_labels=("spark-app-id", "spark-role")
+    )
     node_informer = factory.informer(Node.KIND)
     rr_informer = factory.informer(ResourceReservation.KIND)
     factory.start()

@@ -114,9 +114,16 @@ class Pod(APIObject):
     node_name: str = ""  # spec.nodeName: set on bind
     node_selector: Dict[str, str] = field(default_factory=dict)
     # required node affinity match expressions: label → allowed values
-    # (the reference extracts instance group from nodeAffinity/nodeSelector,
-    # internal/podspec.go:29-53)
+    # (In semantics; the reference extracts instance group from
+    # nodeAffinity/nodeSelector, internal/podspec.go:29-53)
     node_affinity: Dict[str, List[str]] = field(default_factory=dict)
+    # full nodeSelectorTerms with k8s GetRequiredNodeAffinity semantics:
+    # a list of TERMS (OR — a node must satisfy at least one), each a
+    # list of (key, operator, values) expressions (AND within the term);
+    # operators: In/NotIn/Exists/DoesNotExist/Gt/Lt.  When present this
+    # supersedes the simple node_affinity dict (which serde fills only
+    # for the single-term all-In case)
+    affinity_terms: List[list] = field(default_factory=list)
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
     phase: str = PodPhase.PENDING
@@ -131,12 +138,51 @@ class Pod(APIObject):
 
     def matches_node(self, node: "Node") -> bool:
         """Required node affinity + nodeSelector match."""
+        return self.matches_labels(node.labels)
+
+    def matches_labels(self, labels: Dict[str, str]) -> bool:
+        """The k8s required-scheduling match against a label set
+        (component-helpers nodeaffinity semantics, as the reference
+        evaluates via GetRequiredNodeAffinity): nodeSelector entries AND;
+        nodeSelectorTerms OR, expressions within a term AND."""
         for k, v in self.node_selector.items():
-            if node.labels.get(k) != v:
+            if labels.get(k) != v:
                 return False
-        for k, values in self.node_affinity.items():
-            if node.labels.get(k) not in values:
-                return False
+        terms = self.affinity_terms
+        if not terms and self.node_affinity:
+            terms = [[(k, "In", values) for k, values in self.node_affinity.items()]]
+        if not terms:
+            return True
+        return any(self._term_matches(term, labels) for term in terms)
+
+    @staticmethod
+    def _term_matches(term, labels: Dict[str, str]) -> bool:
+        for key, operator, values in term:
+            value = labels.get(key)
+            if operator == "In":
+                if value not in values:
+                    return False
+            elif operator == "NotIn":
+                if value is not None and value in values:
+                    return False
+            elif operator == "Exists":
+                if value is None:
+                    return False
+            elif operator == "DoesNotExist":
+                if value is not None:
+                    return False
+            elif operator in ("Gt", "Lt"):
+                try:
+                    node_val = int(value)
+                    want = int(values[0])
+                except (TypeError, ValueError, IndexError):
+                    return False
+                if operator == "Gt" and not node_val > want:
+                    return False
+                if operator == "Lt" and not node_val < want:
+                    return False
+            else:
+                return False  # unknown operator: fail closed
         return True
 
 

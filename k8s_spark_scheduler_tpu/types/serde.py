@@ -83,13 +83,22 @@ def pod_from_dict(d: dict) -> Pod:
     spec = d.get("spec") or {}
     status = d.get("status") or {}
 
-    node_affinity: Dict[str, List[str]] = {}
     affinity = (spec.get("affinity") or {}).get("nodeAffinity") or {}
     required = affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    affinity_terms: List[list] = []
     for term in required.get("nodeSelectorTerms") or []:
-        for expr in term.get("matchExpressions") or []:
-            if expr.get("operator") == "In":
-                node_affinity[expr.get("key", "")] = list(expr.get("values") or [])
+        parsed_term = [
+            (expr.get("key", ""), expr.get("operator"), list(expr.get("values") or []))
+            for expr in term.get("matchExpressions") or []
+        ]
+        if parsed_term:
+            affinity_terms.append(parsed_term)
+    # the simple In-map convenience view (instance-group extraction) is
+    # only sound for a single all-In term
+    node_affinity: Dict[str, List[str]] = {}
+    if len(affinity_terms) == 1 and all(op == "In" for _, op, _ in affinity_terms[0]):
+        node_affinity = {k: v for k, _, v in affinity_terms[0]}
+        affinity_terms = []
 
     containers = []
     for c in spec.get("containers") or []:
@@ -104,14 +113,24 @@ def pod_from_dict(d: dict) -> Pod:
         node_name=spec.get("nodeName", ""),
         node_selector=dict(spec.get("nodeSelector") or {}),
         node_affinity=node_affinity,
+        affinity_terms=affinity_terms,
         containers=containers,
         phase=status.get("phase", "Pending"),
     )
 
 
 def pod_to_dict(pod: Pod) -> dict:
-    terms = []
-    if pod.node_affinity:
+    if pod.affinity_terms:
+        terms = [
+            {
+                "matchExpressions": [
+                    {"key": k, "operator": op, "values": list(values)}
+                    for k, op, values in term
+                ]
+            }
+            for term in pod.affinity_terms
+        ]
+    elif pod.node_affinity:
         terms = [
             {
                 "matchExpressions": [
@@ -120,6 +139,8 @@ def pod_to_dict(pod: Pod) -> dict:
                 ]
             }
         ]
+    else:
+        terms = []
     return {
         "metadata": meta_to_dict(pod.meta),
         "spec": {

@@ -365,3 +365,24 @@ def test_multihost_mesh_shapes():
     m2 = meshlib.make_multihost_mesh(devices_per_host_axis=True)
     assert m2.axis_names == ("hosts", meshlib.NODE_AXIS)
     assert m2.devices.size == 8
+
+
+def test_min_frag_device_parity_random():
+    rng = random.Random(9090)
+    solver = TpuBatchBinpacker(assignment_policy="minimal-fragmentation")
+    for trial in range(40):
+        metadata = random_cluster(rng, rng.randint(1, 24))
+        app = random_app(rng)
+        driver_order, executor_order = orders_for(metadata, rng)
+        expected = packers.minimal_fragmentation_pack(
+            app.driver_resources, app.executor_resources, app.min_executor_count,
+            driver_order, executor_order, copy_metadata(metadata),
+        )
+        actual = solver(
+            app.driver_resources, app.executor_resources, app.min_executor_count,
+            driver_order, executor_order, copy_metadata(metadata),
+        )
+        assert actual.has_capacity == expected.has_capacity, f"trial {trial}"
+        if expected.has_capacity:
+            assert actual.driver_node == expected.driver_node, f"trial {trial}"
+            assert actual.executor_nodes == expected.executor_nodes, f"trial {trial}"

@@ -127,3 +127,78 @@ def test_list_earlier_drivers_ordering():
     # sorted oldest first; excludes newer, other instance groups, and
     # already-scheduled drivers
     assert [p.name for p in earlier] == [older2.name, older1.name]
+
+
+def test_affinity_operator_matrix():
+    from k8s_spark_scheduler_tpu.types import serde
+
+    pod_json = {
+        "metadata": {"name": "p", "labels": {"spark-role": "driver"}},
+        "spec": {
+            "schedulerName": "spark-scheduler",
+            "affinity": {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "group", "operator": "In", "values": ["a", "b"]},
+                    {"key": "taint", "operator": "NotIn", "values": ["bad"]},
+                    {"key": "gpu", "operator": "Exists"},
+                    {"key": "legacy", "operator": "DoesNotExist"},
+                    {"key": "cores", "operator": "Gt", "values": ["4"]},
+                ]}]}}},
+        },
+    }
+    pod = serde.pod_from_dict(pod_json)
+    good = {"group": "a", "taint": "fine", "gpu": "1", "cores": "8"}
+    assert pod.matches_labels(good)
+    assert not pod.matches_labels(dict(good, group="c"))          # In fails
+    assert not pod.matches_labels(dict(good, taint="bad"))        # NotIn fails
+    assert not pod.matches_labels({k: v for k, v in good.items() if k != "gpu"})  # Exists
+    assert not pod.matches_labels(dict(good, legacy="1"))         # DoesNotExist
+    assert not pod.matches_labels(dict(good, cores="4"))          # Gt fails
+    # round trip keeps expressions (single mixed-operator term)
+    again = serde.pod_from_dict(serde.pod_to_dict(pod))
+    assert again.node_affinity == pod.node_affinity
+    assert again.affinity_terms == pod.affinity_terms
+    assert len(pod.affinity_terms) == 1 and len(pod.affinity_terms[0]) == 5
+
+
+def test_affinity_terms_are_ored():
+    """k8s nodeSelectorTerms semantics: a node need match only ONE term."""
+    from k8s_spark_scheduler_tpu.types import serde
+
+    pod_json = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "schedulerName": "spark-scheduler",
+            "affinity": {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [{"key": "pool", "operator": "In", "values": ["a"]}]},
+                    {"matchExpressions": [{"key": "gpu", "operator": "Exists"}]},
+                ]}}},
+        },
+    }
+    pod = serde.pod_from_dict(pod_json)
+    assert pod.matches_labels({"pool": "a"})          # first term
+    assert pod.matches_labels({"gpu": "v5e"})         # second term
+    assert not pod.matches_labels({"pool": "b"})      # neither
+    # round trip preserves both terms
+    again = serde.pod_from_dict(serde.pod_to_dict(pod))
+    assert again.affinity_terms == pod.affinity_terms
+
+
+def test_instance_group_from_affinity_terms():
+    from k8s_spark_scheduler_tpu.types import serde
+
+    pod_json = {
+        "metadata": {"name": "p"},
+        "spec": {"schedulerName": "spark-scheduler",
+            "affinity": {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [
+                        {"key": "resource_channel", "operator": "In", "values": ["batch"]},
+                        {"key": "gpu", "operator": "Exists"},
+                    ]},
+                ]}}}},
+    }
+    pod = serde.pod_from_dict(pod_json)
+    group, ok = L.find_instance_group_from_pod_spec(pod, "resource_channel")
+    assert ok and group == "batch"

@@ -328,3 +328,32 @@ def test_rate_limited_writes():
         assert elapsed >= 0.3, f"writes were not rate limited ({elapsed:.3f}s)"
     finally:
         cache.stop()
+
+
+def test_informer_label_index():
+    from k8s_spark_scheduler_tpu.kube.informer import Informer
+    from k8s_spark_scheduler_tpu.types.objects import ObjectMeta, Pod
+
+    api = APIServer()
+    inf = Informer(api, "Pod", index_labels=("spark-app-id",))
+    inf.start()
+    for i in range(5):
+        api.create(Pod(meta=ObjectMeta(name=f"p{i}", labels={"spark-app-id": f"app-{i % 2}"})))
+    api.create(Pod(meta=ObjectMeta(name="unlabeled")))
+
+    assert {p.name for p in inf.list(label_selector={"spark-app-id": "app-0"})} == {
+        "p0", "p2", "p4"
+    }
+    # index tracks relabels and deletes
+    p0 = api.get("Pod", "default", "p0")
+    p0.meta.labels["spark-app-id"] = "app-1"
+    api.update(p0)
+    assert {p.name for p in inf.list(label_selector={"spark-app-id": "app-1"})} == {
+        "p0", "p1", "p3"
+    }
+    api.delete("Pod", "default", "p1")
+    assert {p.name for p in inf.list(label_selector={"spark-app-id": "app-1"})} == {
+        "p0", "p3"
+    }
+    # combined selectors still filter correctly through the index
+    assert inf.list(label_selector={"spark-app-id": "app-1", "other": "x"}) == []
