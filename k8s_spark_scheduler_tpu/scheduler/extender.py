@@ -12,6 +12,7 @@ single-AZ confinement, soft-reservation consumption.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -114,6 +115,11 @@ class SparkSchedulerExtender:
         # only when no label-priority re-sort is configured (the fast
         # lexsort replicates the default NodeSorter ordering)
         self._tensor_snapshot = tensor_snapshot_cache
+        # kube-scheduler serializes Filter calls per scheduler instance
+        # (SURVEY §2.10); the reference's state (lastRequest, the
+        # reconcile-then-pack flow) relies on that — enforce it here so a
+        # threaded HTTP front end can't interleave predicates
+        self._predicate_lock = threading.Lock()
         self._fast_path_ok = (
             tensor_snapshot_cache is not None
             and node_sorter._driver_less_than is None
@@ -125,6 +131,10 @@ class SparkSchedulerExtender:
 
     def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
         """resource.go:128-183."""
+        with self._predicate_lock:
+            return self._predicate_locked(args)
+
+    def _predicate_locked(self, args: ExtenderArgs) -> ExtenderFilterResult:
         pod = args.pod
         role = pod.labels.get(L.SPARK_ROLE_LABEL, "")
         instance_group, ok = L.find_instance_group_from_pod_spec(pod, self._instance_group_label)

@@ -16,6 +16,14 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _ExtenderHTTPD(ThreadingHTTPServer):
+    # socketserver defaults to a 5-connection listen backlog; a
+    # kube-scheduler burst (or parallel probes) overflows that and the
+    # kernel resets connections
+    request_queue_size = 128
+    daemon_threads = True
 from typing import Optional
 
 from ..types import serde
@@ -125,7 +133,7 @@ class ExtenderHTTPServer:
             (_Handler,),
             {"scheduler": scheduler, "webhook_only": webhook_only},
         )
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _ExtenderHTTPD((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 
     @property

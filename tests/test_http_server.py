@@ -256,3 +256,49 @@ def test_static_compaction_integration(served):
         patched = server_rr.status.pods.get("executor-1") == executor.name
         time.sleep(0.01)
     assert patched, server_rr.status.pods
+
+
+def test_concurrent_predicates_soak(served):
+    """Parallel Filter requests from many client threads must neither
+    crash nor double-book: every successful gang keeps reservation
+    accounting consistent (kube-scheduler serializes per instance; the
+    extender enforces the same internally for threaded front ends)."""
+    import threading
+
+    api, scheduler, http = served
+    _create_nodes(api, count=4)
+    nodes = [f"n{i}" for i in range(4)]
+
+    results = {}
+    errors = []
+
+    def submit(i):
+        try:
+            pods = Harness.static_allocation_spark_pods(f"soak-{i}", 2)
+            api.create(serde.pod_from_dict(serde.pod_to_dict(pods[0])))
+            status, out = _post(
+                http.port,
+                "/predicates",
+                {"Pod": serde.pod_to_dict(pods[0]), "NodeNames": nodes},
+            )
+            results[i] = (status, tuple(out.get("NodeNames") or []))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert not errors
+    assert all(status == 200 for status, _ in results.values())
+    # 4 nodes x 8cpu = 32 cpu; each app needs 3 -> exactly 10 fit
+    granted = [i for i, (_, ns) in results.items() if ns]
+    assert len(granted) == 10
+    # accounting: total reserved cpu across RRs never exceeds capacity
+    total = 0
+    for rr in scheduler.resource_reservation_cache.list():
+        for res in rr.spec.reservations.values():
+            total += res.resources_value().cpu.value()
+    assert total <= 32, total
