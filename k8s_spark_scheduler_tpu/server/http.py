@@ -16,6 +16,12 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..types import serde
+from .wiring import Server
+
+logger = logging.getLogger(__name__)
 
 
 class _ExtenderHTTPD(ThreadingHTTPServer):
@@ -23,13 +29,6 @@ class _ExtenderHTTPD(ThreadingHTTPServer):
     # kube-scheduler burst (or parallel probes) overflows that and the
     # kernel resets connections
     request_queue_size = 128
-    daemon_threads = True
-from typing import Optional
-
-from ..types import serde
-from .wiring import Server
-
-logger = logging.getLogger(__name__)
 
 
 def convert_review(body: dict) -> dict:

@@ -321,3 +321,43 @@ def test_dynamic_allocation_cross_node_compaction_keeps_reservation_node(harness
     assert rr.status.pods["executor-1"] == execs[1].name
     # the reservation's node must be unchanged even if the pod runs elsewhere
     assert rr.spec.reservations["executor-1"].node == hard_node
+
+
+def test_heterogeneous_instance_groups():
+    """Bench config (3): multi-instance-group nodes with node-selector
+    affinity — apps must confine to their group and account capacity
+    per group."""
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        for i in range(2):
+            h.new_node(f"big-{i}", cpu="16", memory="32Gi", instance_group="batch-big")
+        for i in range(3):
+            h.new_node(f"small-{i}", cpu="4", memory="8Gi", instance_group="batch-small")
+        all_nodes = [f"big-{i}" for i in range(2)] + [f"small-{i}" for i in range(3)]
+
+        big_pods = h.static_allocation_spark_pods(
+            "app-big", 4, driver_cpu="2", driver_mem="4Gi",
+            executor_cpu="4", executor_mem="8Gi", instance_group="batch-big",
+        )
+        small_pods = h.static_allocation_spark_pods(
+            "app-small", 2, instance_group="batch-small"
+        )
+
+        node = h.assert_success(h.schedule(big_pods[0], all_nodes))
+        assert node.startswith("big-")
+        node = h.assert_success(h.schedule(small_pods[0], all_nodes))
+        assert node.startswith("small-")
+        for p in big_pods[1:]:
+            assert h.assert_success(h.schedule(p, all_nodes)).startswith("big-")
+        for p in small_pods[1:]:
+            assert h.assert_success(h.schedule(p, all_nodes)).startswith("small-")
+
+        # a big-group app that exceeds the big group's remaining capacity
+        # must fail even though the small group has room
+        overflow = h.static_allocation_spark_pods(
+            "app-overflow", 8, executor_cpu="4", executor_mem="8Gi",
+            instance_group="batch-big",
+        )[0]
+        h.assert_failure(h.schedule(overflow, all_nodes))
+    finally:
+        h.close()

@@ -1,0 +1,80 @@
+"""Multi-host distributed solve: two real processes join via
+jax.distributed, build a global mesh, and run the sharded whole-queue
+solve (the DCN story of SURVEY §2.10 / §5, validated on CPU)."""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from k8s_spark_scheduler_tpu.parallel import mesh as meshlib
+
+    meshlib.initialize_multihost(
+        coordinator_address="127.0.0.1:" + sys.argv[2],
+        num_processes=2,
+        process_id=int(sys.argv[1]),
+    )
+    import numpy as np
+
+    assert len(jax.devices()) == 8
+    sys.path.insert(0, {repo!r})
+    import __graft_entry__ as g
+    from k8s_spark_scheduler_tpu.models.gang_packer import GangPacker, GangPackerConfig
+
+    packer = GangPacker(GangPackerConfig(use_mesh=True), devices=list(jax.devices()))
+    problem = g._example_problem(n_nodes=32, n_apps=4, node_bucket=64, app_bucket=16)
+    out = packer.solve(problem)
+    assert np.asarray(out.feasible)[:4].all()
+    print("MULTIHOST_OK", int(np.asarray(out.feasible).sum()))
+    """
+)
+
+
+def test_two_process_mesh_solve(tmp_path):
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=repo))
+    port = str(_free_port())
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), outputs
+    assert all("MULTIHOST_OK 4" in out for out in outputs), outputs
