@@ -128,8 +128,14 @@ def main() -> None:
     if on_tpu:
         from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue
 
+        # grid batching knob for A/B on hardware (parity-validated for 1
+        # and 8; see tests/test_pallas_queue.py)
+        apps_per_step = int(os.environ.get("BENCH_APPS_PER_STEP", "1"))
+
         def one_solve(avail, rest):
-            feas, didx, avail_after = pallas_solve_queue(avail, *rest)
+            feas, didx, avail_after = pallas_solve_queue(
+                avail, *rest, apps_per_step=apps_per_step
+            )
             return feas, avail_after
     else:
 

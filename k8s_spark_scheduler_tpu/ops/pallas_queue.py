@@ -76,7 +76,7 @@ def _queue_kernel(
     rank_ref,      # [R, 128] int32 driver rank (BIG = not a candidate)
     execok_ref,    # [R, 128] int32 0/1
     # outputs
-    feas_ref,      # [1, 128] int32 per app (lane 0 = feasible, lane 1 = driver idx)
+    feas_ref,      # per-app rows (lane 0 = feasible, lane 1 = driver idx)
     avail_out,     # [R, 128] ×3 final availability planes
     availm_out,
     availg_out,
@@ -85,86 +85,93 @@ def _queue_kernel(
     *,
     evenly: bool,
     n_apps: int,
+    apps_per_step: int,
 ):
-    a = pl.program_id(0)
+    i = pl.program_id(0)
 
-    @pl.when(a == 0)
+    @pl.when(i == 0)
     def _init():
         ac[...] = avail0[...]
         am[...] = availm0[...]
         ag[...] = availg0[...]
 
-    dr = jnp.array([dcpu[a], dmem[a], dgpu[a]], dtype=jnp.int32)
-    ex = jnp.array([ecpu[a], emem[a], egpu[a]], dtype=jnp.int32)
-    k = ks[a]
-    valid = valids[a]
-
     rank = rank_ref[...]
     exec_ok = execok_ref[...] != 0
-    cpu, mem, gpu = ac[...], am[...], ag[...]
-
-    def caps(c, m, g):
-        def dim(avail_d, req):
-            return jnp.where(req == 0, BIG, lax.div(avail_d, jnp.maximum(req, 1)))
-
-        cap = jnp.minimum(jnp.minimum(dim(c, ex[0]), dim(m, ex[1])), dim(g, ex[2]))
-        return jnp.clip(cap, 0, k)
-
-    base_cap = jnp.where(exec_ok, caps(cpu, mem, gpu), 0)
-    cap_with_driver = jnp.where(
-        exec_ok, caps(cpu - dr[0], mem - dr[1], gpu - dr[2]), 0
-    )
-
-    driver_fits = (cpu >= dr[0]) & (mem >= dr[1]) & (gpu >= dr[2]) & (rank < BIG)
-    total = jnp.sum(base_cap)
-    total_d = total - base_cap + cap_with_driver
-    feasible_d = driver_fits & (total_d >= k)
-
-    masked_rank = jnp.where(feasible_d, rank, BIG)
-    best_rank = jnp.min(masked_rank)
-    feasible = (best_rank < BIG) & (valid != 0)
-
     rows, lanes = rank.shape
     row_ids = lax.broadcasted_iota(jnp.int32, (rows, lanes), 0)
     lane_ids = lax.broadcasted_iota(jnp.int32, (rows, lanes), 1)
     node_ids = row_ids * lanes + lane_ids
-    # ranks are unique, so the min-rank node is unique when feasible
-    # (mosaic has no int argmin: recover the index via a masked min)
-    flat_idx = jnp.min(jnp.where(masked_rank == best_rank, node_ids, BIG))
-    is_driver = (node_ids == flat_idx) & feasible
-
-    cap = jnp.where(is_driver, cap_with_driver, base_cap)
-    cap = jnp.where(feasible, cap, 0)
-
-    if evenly:
-        has = (cap > 0).astype(jnp.int32)
-        rank_excl = _flat_cumsum_exclusive(has)
-        exec_mask = (cap > 0) & (rank_excl < k)
-    else:
-        cum_excl = _flat_cumsum_exclusive(cap)
-        x = jnp.clip(k - cum_excl, 0, cap)
-        exec_mask = x > 0
-    exec_mask = exec_mask & feasible
-
-    # the reference's usage-subtraction quirk: executor overwrites driver
-    dc = jnp.where(exec_mask, ex[0], jnp.where(is_driver, dr[0], 0))
-    dm = jnp.where(exec_mask, ex[1], jnp.where(is_driver, dr[1], 0))
-    dg = jnp.where(exec_mask, ex[2], jnp.where(is_driver, dr[2], 0))
-    ac[...] = cpu - dc
-    am[...] = mem - dm
-    ag[...] = gpu - dg
-
-    # outputs are blocked 8 apps per (8, 128) tile; this app's row is a%8
     out_lanes = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-    idx_val = jnp.where(feasible, flat_idx, jnp.int32(rows * lanes))
-    out_row = jnp.where(
-        out_lanes == 0,
-        feasible.astype(jnp.int32),
-        jnp.where(out_lanes == 1, idx_val, 0),
-    )
-    feas_ref[pl.ds(a % 8, 1), :] = out_row
 
-    @pl.when(a == n_apps - 1)
+    # the grid sequences blocks of `apps_per_step` apps; the inner loop is
+    # unrolled at trace time, amortizing per-grid-step overhead (grid
+    # pipelining + output DMA) over several apps
+    for j in range(apps_per_step):
+        a = i * apps_per_step + j
+        dr = jnp.array([dcpu[a], dmem[a], dgpu[a]], dtype=jnp.int32)
+        ex = jnp.array([ecpu[a], emem[a], egpu[a]], dtype=jnp.int32)
+        k = ks[a]
+        valid = valids[a]
+
+        cpu, mem, gpu = ac[...], am[...], ag[...]
+
+        def caps(c, m, g, ex=ex, k=k):
+            def dim(avail_d, req):
+                return jnp.where(req == 0, BIG, lax.div(avail_d, jnp.maximum(req, 1)))
+
+            cap = jnp.minimum(jnp.minimum(dim(c, ex[0]), dim(m, ex[1])), dim(g, ex[2]))
+            return jnp.clip(cap, 0, k)
+
+        base_cap = jnp.where(exec_ok, caps(cpu, mem, gpu), 0)
+        cap_with_driver = jnp.where(
+            exec_ok, caps(cpu - dr[0], mem - dr[1], gpu - dr[2]), 0
+        )
+
+        driver_fits = (cpu >= dr[0]) & (mem >= dr[1]) & (gpu >= dr[2]) & (rank < BIG)
+        total = jnp.sum(base_cap)
+        total_d = total - base_cap + cap_with_driver
+        feasible_d = driver_fits & (total_d >= k)
+
+        masked_rank = jnp.where(feasible_d, rank, BIG)
+        best_rank = jnp.min(masked_rank)
+        feasible = (best_rank < BIG) & (valid != 0)
+
+        # ranks are unique, so the min-rank node is unique when feasible
+        # (mosaic has no int argmin: recover the index via a masked min)
+        flat_idx = jnp.min(jnp.where(masked_rank == best_rank, node_ids, BIG))
+        is_driver = (node_ids == flat_idx) & feasible
+
+        cap = jnp.where(is_driver, cap_with_driver, base_cap)
+        cap = jnp.where(feasible, cap, 0)
+
+        if evenly:
+            has = (cap > 0).astype(jnp.int32)
+            rank_excl = _flat_cumsum_exclusive(has)
+            exec_mask = (cap > 0) & (rank_excl < k)
+        else:
+            cum_excl = _flat_cumsum_exclusive(cap)
+            x = jnp.clip(k - cum_excl, 0, cap)
+            exec_mask = x > 0
+        exec_mask = exec_mask & feasible
+
+        # the reference's usage-subtraction quirk: executor overwrites driver
+        dc = jnp.where(exec_mask, ex[0], jnp.where(is_driver, dr[0], 0))
+        dm = jnp.where(exec_mask, ex[1], jnp.where(is_driver, dr[1], 0))
+        dg = jnp.where(exec_mask, ex[2], jnp.where(is_driver, dr[2], 0))
+        ac[...] = cpu - dc
+        am[...] = mem - dm
+        ag[...] = gpu - dg
+
+        # outputs: 8 app-rows per (8, 128) tile
+        idx_val = jnp.where(feasible, flat_idx, jnp.int32(rows * lanes))
+        out_row = jnp.where(
+            out_lanes == 0,
+            feasible.astype(jnp.int32),
+            jnp.where(out_lanes == 1, idx_val, 0),
+        )
+        feas_ref[pl.ds((i * apps_per_step + j) % 8, 1), :] = out_row
+
+    @pl.when(i == (n_apps // apps_per_step) - 1)
     def _final():
         avail_out[...] = ac[...]
         availm_out[...] = am[...]
@@ -172,7 +179,7 @@ def _queue_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("evenly", "interpret")
+    jax.jit, static_argnames=("evenly", "interpret", "apps_per_step")
 )
 def pallas_solve_queue(
     avail: jnp.ndarray,        # [N, 3] int32 (N multiple of LANES*8 preferred)
@@ -184,10 +191,20 @@ def pallas_solve_queue(
     app_valid: jnp.ndarray,    # [A] bool
     evenly: bool = False,
     interpret: bool = False,
+    apps_per_step: int = 1,
 ):
-    """Returns (feasible[A] bool, driver_idx[A] int32, avail_after[N,3])."""
+    """Returns (feasible[A] bool, driver_idx[A] int32, avail_after[N,3]).
+
+    apps_per_step batches several apps per grid step (unrolled in the
+    kernel body) to amortize per-step overhead; must divide the app
+    count and 8 (the output tile height).
+    """
     n = avail.shape[0]
     a = drivers.shape[0]
+    if apps_per_step <= 0 or a % apps_per_step or 8 % apps_per_step:
+        raise ValueError(
+            f"apps_per_step={apps_per_step} must be positive and divide {a} and 8"
+        )
     rows, padded = _row_layout(n)
 
     def plane(v, fill=0):
@@ -201,13 +218,16 @@ def pallas_solve_queue(
     rank_p = plane(driver_rank, fill=int(BIG))
     exec_p = plane(exec_ok.astype(jnp.int32))
 
-    kernel = functools.partial(_queue_kernel, evenly=evenly, n_apps=a)
+    kernel = functools.partial(
+        _queue_kernel, evenly=evenly, n_apps=a, apps_per_step=apps_per_step
+    )
+    g = apps_per_step
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=8,
-        grid=(a,),
+        grid=(a // g,),
         in_specs=[pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0))] * 5,
         out_specs=[
-            pl.BlockSpec((8, LANES), lambda i, *refs: (i // 8, 0)),
+            pl.BlockSpec((8, LANES), lambda i, *refs: ((i * g) // 8, 0)),
             pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
             pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
             pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),

@@ -361,3 +361,48 @@ def test_heterogeneous_instance_groups():
         h.assert_failure(h.schedule(overflow, all_nodes))
     finally:
         h.close()
+
+
+def test_single_az_dynamic_allocation_confinement():
+    """resource.go:606-636: with a single-AZ packer + the DA-same-AZ
+    flag, extra executors are confined to the zone the app runs in, and
+    a zone-pinned demand is created when that zone is full."""
+    h = Harness(
+        binpack_algo="single-az-tightly-pack",
+        dynamic_allocation_single_az=True,
+    )
+    try:
+        h.new_node("a1", cpu="4", memory="4Gi", zone="az-a")
+        h.new_node("a2", cpu="4", memory="4Gi", zone="az-a")
+        h.new_node("b1", cpu="16", memory="16Gi", zone="az-b")
+        nodes = ["a1", "a2", "b1"]
+
+        # DA app: min 1, max 6 — driver + first executor land in one zone
+        pods = h.dynamic_allocation_spark_pods(
+            "app-zaz", 1, 6, executor_cpu="2", executor_mem="2Gi"
+        )
+        driver, execs = pods[0], pods[1:]
+        driver_node = h.assert_success(h.schedule(driver, nodes))
+        first = h.assert_success(h.schedule(execs[0], nodes))
+        zone_of = {"a1": "az-a", "a2": "az-a", "b1": "az-b"}
+        app_zone = zone_of[driver_node]
+        assert zone_of[first] == app_zone
+
+        # the app zone (az-a: 8 cpu total) fills; extra executors must
+        # NOT spill into az-b even though b1 has plenty of room
+        granted = []
+        for e in execs[1:]:
+            r = h.schedule(e, nodes)
+            if r.node_names:
+                assert zone_of[r.node_names[0]] == app_zone, r.node_names
+                granted.append(r.node_names[0])
+        assert granted, "some extras should fit in the app zone"
+        assert len(granted) < 5, "zone confinement must reject the overflow"
+
+        # the failed extras created zone-pinned demands
+        assert h.wait_for_api(lambda: len(h.api.list("Demand")) >= 1)
+        demand = h.api.list("Demand")[0]
+        assert demand.spec.zone == app_zone
+        assert demand.spec.enforce_single_zone_scheduling
+    finally:
+        h.close()

@@ -34,7 +34,6 @@ WORKER = textwrap.dedent(
     import numpy as np
 
     assert len(jax.devices()) == 8
-    sys.path.insert(0, {repo!r})
     import __graft_entry__ as g
     from k8s_spark_scheduler_tpu.models.gang_packer import GangPacker, GangPackerConfig
 

@@ -34,8 +34,9 @@ def _problem(rng, n_nodes, n_apps):
     return problem
 
 
+@pytest.mark.parametrize("apps_per_step", [1, 2, 4, 8])
 @pytest.mark.parametrize("evenly", [False, True])
-def test_pallas_matches_xla_scan(evenly):
+def test_pallas_matches_xla_scan(evenly, apps_per_step):
     rng = random.Random(2024)
     for trial in range(6):
         problem = _problem(rng, rng.randint(2, 40), rng.randint(1, 24))
@@ -49,7 +50,9 @@ def test_pallas_matches_xla_scan(evenly):
             jnp.asarray(problem.app_valid),
         )
         ref = solve_queue(*args, evenly=evenly, with_placements=False)
-        feas, didx, avail_after = pallas_solve_queue(*args, evenly=evenly, interpret=True)
+        feas, didx, avail_after = pallas_solve_queue(
+            *args, evenly=evenly, interpret=True, apps_per_step=apps_per_step
+        )
         assert (np.asarray(feas) == np.asarray(ref.feasible)).all(), f"trial {trial}"
         assert (np.asarray(didx) == np.asarray(ref.driver_idx)).all(), f"trial {trial}"
         assert (np.asarray(avail_after) == np.asarray(ref.avail_after)).all(), f"trial {trial}"
