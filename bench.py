@@ -183,7 +183,6 @@ def main() -> None:
         "vs_baseline": round(TARGET_MS / p99, 3),
     }
     print(json.dumps(result))
-    _secondary_configs()
     print(
         f"# p50={np.percentile(lat, 50):.2f}ms mean={lat.mean():.2f}ms "
         f"max={lat.max():.2f}ms relay_rtt={rtt_s * 1000:.1f}ms "
@@ -192,6 +191,7 @@ def main() -> None:
         f"backend={'pallas' if on_tpu else 'xla-scan'} chain={CHAIN}",
         file=sys.stderr,
     )
+    _secondary_configs()
 
 
 def _secondary_configs() -> None:
@@ -257,8 +257,11 @@ def _secondary_configs() -> None:
     except Exception as err:  # diagnostics must never break the bench
         print(f"# secondary configs failed: {err}", file=sys.stderr)
     finally:
-        if h is not None:
-            h.close()
+        try:
+            if h is not None:
+                h.close()
+        except Exception:
+            pass
         logging.disable(logging.NOTSET)
 
 

@@ -15,6 +15,8 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -66,6 +68,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        trace = getattr(self, "_trace", None)
+        if trace is not None:
+            trace_id, t0 = trace
+            self.send_header("X-Trace-Id", trace_id)
+            logger.info(
+                "request traceId=%s path=%s status=%d durationMs=%.1f",
+                trace_id,
+                self.path,
+                code,
+                (time.perf_counter() - t0) * 1000.0,
+            )
         self.end_headers()
         self.wfile.write(data)
 
@@ -75,6 +88,7 @@ class _Handler(BaseHTTPRequestHandler):
         return json.loads(raw or b"{}")
 
     def do_GET(self):
+        self._begin_trace()
         if self.path == "/status/liveness":
             self._send_json(200, {"status": "up"})
         elif self.path == "/status/readiness":
@@ -88,7 +102,15 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": "not found"})
 
+    def _begin_trace(self):
+        # request tracing (the reference's witchcraft request log / trc1
+        # analog): a trace id per request, echoed in the response header
+        # and the request log line with the handler duration
+        trace_id = self.headers.get("X-Trace-Id") or uuid.uuid4().hex[:16]
+        self._trace = (trace_id, time.perf_counter())
+
     def do_POST(self):
+        self._begin_trace()
         try:
             body = self._read_json()
         except (ValueError, json.JSONDecodeError) as err:

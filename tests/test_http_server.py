@@ -302,3 +302,25 @@ def test_concurrent_predicates_soak(served):
         for res in rr.spec.reservations.values():
             total += res.resources_value().cpu.value()
     assert total <= 32, total
+
+
+def test_request_tracing_header(served):
+    _, _, http = served
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http.port}/convert",
+        data=b'{"request": {"uid": "t", "objects": []}}',
+        headers={"X-Trace-Id": "my-trace-123"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers.get("X-Trace-Id") == "my-trace-123"
+    # auto-generated when absent
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http.port}/convert",
+        data=b'{"request": {"uid": "t", "objects": []}}',
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers.get("X-Trace-Id")
