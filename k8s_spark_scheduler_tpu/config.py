@@ -1,8 +1,8 @@
 """Install-time configuration (reference ``config/config.go:24-84``).
 
 The reference binds ``var/conf/install.yml`` into the Install struct; we
-accept the same shape from a dict / YAML-ish mapping (no YAML dependency:
-the server loads JSON or receives a dict directly).
+accept the same shape from a dict — the server CLI parses JSON natively
+and YAML when pyyaml is installed (the optional ``[yaml]`` extra).
 """
 
 from __future__ import annotations

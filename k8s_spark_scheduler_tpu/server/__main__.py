@@ -71,7 +71,22 @@ def main(argv=None) -> int:
     install = Install()
     if args.config:
         with open(args.config) as f:
-            install = Install.from_dict(json.load(f))
+            raw = f.read()
+        if args.config.endswith((".yml", ".yaml")):
+            # the reference's install.yml shape (config/config.go);
+            # pyyaml ships as the optional [yaml] extra
+            try:
+                import yaml
+            except ImportError:
+                print(
+                    "YAML configs need pyyaml (pip install 'tpu-gang-scheduler[yaml]') "
+                    "or use a JSON config",
+                    file=sys.stderr,
+                )
+                return 2
+            install = Install.from_dict(yaml.safe_load(raw) or {})
+        else:
+            install = Install.from_dict(json.loads(raw))
 
     api = APIServer()
     scheduler = init_server_with_clients(api, install)

@@ -1,0 +1,85 @@
+"""Fake cluster autoscaler for end-to-end flows (the reference's demand
+consumer is an external scaler watching the Demand CRD, SURVEY §1).
+
+Watches Demands on the embedded API server; for each pending demand it
+adds nodes sized to the demand units (in the demanded zone when
+enforce_single_zone_scheduling is set) and marks the demand fulfilled —
+driving the same phase transitions the waste reporter and demand GC key
+on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+from ..kube.apiserver import APIServer
+from ..kube.informer import Informer
+from ..types.objects import Demand, DemandPhase, Node, ObjectMeta
+from ..types.resources import ZONE_LABEL, Resources
+
+_counter = itertools.count(1)
+
+
+class FakeAutoscaler:
+    def __init__(
+        self,
+        api: APIServer,
+        demand_informer: Informer,
+        node_cpu: str = "16",
+        node_memory: str = "32Gi",
+        node_gpu: str = "0",
+        instance_group_label: str = "resource_channel",
+        default_zone: str = "zone1",
+    ):
+        self._api = api
+        self._node_cpu = node_cpu
+        self._node_memory = node_memory
+        self._node_gpu = node_gpu
+        self._instance_group_label = instance_group_label
+        self._default_zone = default_zone
+        self._lock = threading.Lock()
+        self.fulfilled: list[str] = []
+        demand_informer.add_event_handler(on_add=self._on_demand)
+
+    def _on_demand(self, demand: Demand) -> None:
+        with self._lock:
+            if demand.status.phase == DemandPhase.FULFILLED:
+                return
+            zone = demand.spec.zone or self._default_zone
+            node_capacity = Resources.of(self._node_cpu, self._node_memory, self._node_gpu)
+            # first-fit the demand units onto fresh nodes: summed-demand
+            # division under-provisions when unit sizes don't divide node
+            # capacity (a 10-cpu unit only fits once on a 16-cpu node)
+            needed = 1
+            free: list[Resources] = []
+            for unit in demand.spec.units:
+                for _ in range(unit.count):
+                    placed = False
+                    for i, avail in enumerate(free):
+                        if not unit.resources.greater_than(avail):
+                            free[i] = avail.sub(unit.resources)
+                            placed = True
+                            break
+                    if not placed:
+                        free.append(node_capacity.sub(unit.resources))
+            needed = max(len(free), 1)
+            for _ in range(needed):
+                self._api.create(
+                    Node(
+                        meta=ObjectMeta(
+                            name=f"scaled-{next(_counter)}",
+                            labels={
+                                ZONE_LABEL: zone,
+                                self._instance_group_label: demand.spec.instance_group,
+                            },
+                        ),
+                        allocatable=node_capacity,
+                    )
+                )
+            fresh = self._api.get(Demand.KIND, demand.namespace, demand.name)
+            fresh.status.phase = DemandPhase.FULFILLED
+            fresh.status.fulfilled_zone = zone
+            self._api.update(fresh)
+            self.fulfilled.append(demand.name)

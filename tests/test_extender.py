@@ -406,3 +406,63 @@ def test_single_az_dynamic_allocation_confinement():
         assert demand.spec.enforce_single_zone_scheduling
     finally:
         h.close()
+
+
+def test_autoscaler_fulfillment_end_to_end():
+    """Full demand loop: no capacity -> demand -> fake autoscaler adds
+    nodes + fulfills -> retry schedules -> demand deleted -> waste
+    metrics attribute the phases."""
+    from k8s_spark_scheduler_tpu.metrics import names
+    from k8s_spark_scheduler_tpu.testing.fake_autoscaler import FakeAutoscaler
+
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        h.new_node("n1", cpu="2", memory="2Gi")
+        demand_informer = h.server.lazy_demand_informer.informer()
+        scaler = FakeAutoscaler(h.api, demand_informer)
+
+        driver = h.static_allocation_spark_pods("app-auto", 6)[0]
+        h.assert_failure(h.schedule(driver, ["n1"]))
+        # the autoscaler reacts to the demand synchronously (watch events)
+        assert h.wait_for_api(lambda: scaler.fulfilled)
+        scaled = [n.name for n in h.api.list("Node") if n.name.startswith("scaled-")]
+        assert scaled
+
+        # kube-scheduler retries with the new node list
+        result = h.schedule(driver, ["n1"] + scaled)
+        node = h.assert_success(result)
+        assert node in scaled or node == "n1"
+        assert h.wait_for_api(lambda: len(h.api.list("Demand")) == 0)
+
+        m = h.server.metrics
+        fulfilled_waste = m.get_histogram(
+            names.SCHEDULING_WASTE, {names.TAG_WASTE_TYPE: "after-demand-fulfilled"}
+        )
+        assert fulfilled_waste["count"] == 1
+    finally:
+        h.close()
+
+
+def test_autoscaler_provisions_for_indivisible_units():
+    """Unit sizes that don't divide node capacity must still get enough
+    nodes (first-fit provisioning, not summed division)."""
+    from k8s_spark_scheduler_tpu.testing.fake_autoscaler import FakeAutoscaler
+
+    h = Harness(binpack_algo="tightly-pack")
+    try:
+        h.new_node("n1", cpu="1", memory="1Gi")
+        scaler = FakeAutoscaler(
+            h.api, h.server.lazy_demand_informer.informer(), node_cpu="16", node_memory="32Gi"
+        )
+        # 3 executors x 10 cpu: one fits per 16-cpu node -> needs 3 nodes
+        driver = h.static_allocation_spark_pods(
+            "app-indiv", 3, driver_cpu="1", driver_mem="1Gi",
+            executor_cpu="10", executor_mem="4Gi",
+        )[0]
+        h.assert_failure(h.schedule(driver, ["n1"]))
+        assert h.wait_for_api(lambda: scaler.fulfilled)
+        scaled = [n.name for n in h.api.list("Node") if n.name.startswith("scaled-")]
+        assert len(scaled) >= 3, scaled
+        h.assert_success(h.schedule(driver, ["n1"] + scaled))
+    finally:
+        h.close()
