@@ -53,12 +53,28 @@ def build_cluster_tensor(
         return empty, {}
 
     # required node affinity + nodeSelector filter (metadata membership),
-    # via the same matcher the slow path uses
-    eligible = np.fromiter(
-        (driver_pod.matches_labels(labels) for labels in snap.labels),
-        dtype=bool,
-        count=n,
+    # via the same matcher the slow path uses.  The dominant real-world
+    # shape — a single In-constraint on one label (the instance group) —
+    # is vectorized; anything else falls back to the general matcher.
+    single_in = (
+        not driver_pod.node_selector
+        and not driver_pod.affinity_terms
+        and len(driver_pod.node_affinity) == 1
     )
+    if single_in:
+        ((key, values),) = driver_pod.node_affinity.items()
+        allowed = set(values)
+        eligible = np.fromiter(
+            (labels.get(key) in allowed for labels in snap.labels),
+            dtype=bool,
+            count=n,
+        )
+    else:
+        eligible = np.fromiter(
+            (driver_pod.matches_labels(labels) for labels in snap.labels),
+            dtype=bool,
+            count=n,
+        )
     idx = np.flatnonzero(eligible)
     if len(idx) == 0:
         idx = np.zeros(0, dtype=np.int64)
@@ -85,32 +101,32 @@ def build_cluster_tensor(
     name_rank = np.argsort(np.argsort(np.array(names, dtype=object)))
     order = np.lexsort((name_rank, avail[:, 0], avail[:, 1], zone_priority[zone_id]))
 
-    candidate_set = set(candidate_names)
-    driver_rank = np.full(len(names), INT32_SAFE, dtype=np.int32)
-    rank = 0
-    exec_ok = np.zeros(len(names), dtype=bool)
-    ordered_names: List[str] = []
-    for pos in order:
-        name = names[pos]
-        ordered_names.append(name)
-        if name in candidate_set:
-            driver_rank[pos] = rank
-            rank += 1
-        exec_ok[pos] = bool(ready[pos]) and not bool(unsched[pos])
-
-    # the solver's array order must equal the executor priority order:
-    # reorder everything by `order`
+    # reorder everything into executor priority order, then assign driver
+    # ranks by cumulative candidate count — all vectorized
     perm = order
+    names_arr = np.array(names, dtype=object)[perm]
+    candidate_set = set(candidate_names)
+    cand_mask = np.fromiter(
+        (name in candidate_set for name in names_arr), dtype=bool, count=len(names_arr)
+    )
+    driver_rank = np.full(len(names_arr), INT32_SAFE, dtype=np.int64)
+    driver_rank[cand_mask] = np.arange(int(cand_mask.sum()))
+    exec_ok = ready[perm] & ~unsched[perm]
+    ordered_names = list(names_arr)
+
     cluster = ClusterTensor(
         node_names=ordered_names,
         avail=avail[perm],
         sched=sched[perm],
-        driver_rank=driver_rank[perm],
-        exec_ok=exec_ok[perm],
+        driver_rank=driver_rank.astype(np.int32),
+        exec_ok=exec_ok,
         zone_id=zone_id[perm].astype(np.int32),
         zone_names=list(snap.zone_names),
         valid=np.ones(len(ordered_names), dtype=bool),
         exact=True,
     )
-    zones = {name: snap.zone_names[zone_id[pos]] for pos, name in zip(perm, ordered_names)}
+    zone_ordered = zone_id[perm]
+    zones = {
+        name: snap.zone_names[zone_ordered[i]] for i, name in enumerate(ordered_names)
+    }
     return cluster, zones
