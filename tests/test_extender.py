@@ -466,3 +466,56 @@ def test_autoscaler_provisions_for_indivisible_units():
         h.assert_success(h.schedule(driver, ["n1"] + scaled))
     finally:
         h.close()
+
+
+def test_executor_rebind_storm():
+    """Mass executor death: every replacement must take over a dead
+    executor's reservation (reservation nodes unchanged), never leak
+    spots, and reject the N+1th replacement."""
+    import random
+
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        for i in range(8):
+            h.new_node(f"n{i}", cpu="16", memory="16Gi")
+        nodes = [f"n{i}" for i in range(8)]
+        pods = h.static_allocation_spark_pods("app-storm", 50)
+        driver, execs = pods[0], pods[1:]
+        h.assert_success(h.schedule(driver, nodes))
+        for e in execs:
+            h.assert_success(h.schedule(e, nodes))
+
+        rr_before = h.get_resource_reservation("app-storm")
+        mapping_before = {
+            name: r.node for name, r in rr_before.spec.reservations.items()
+        }
+
+        rng = random.Random(5)
+        victims = rng.sample(execs, 25)
+        for v in victims:
+            h.delete_pod(v)
+
+        replacements = []
+        for i, v in enumerate(victims):
+            rep = h.static_allocation_spark_pods("app-storm", 1)[1]
+            rep.meta.name = f"app-storm-rep-{i}"
+            node = h.assert_success(h.schedule(rep, nodes))
+            replacements.append((rep, node))
+
+        rr_after = h.get_resource_reservation("app-storm")
+        # per-reservation node mapping unchanged; every replacement is bound
+        assert {
+            name: r.node for name, r in rr_after.spec.reservations.items()
+        } == mapping_before
+        bound = set(rr_after.status.pods.values())
+        for rep, node in replacements:
+            assert rep.name in bound
+        # no victim remains bound
+        assert not bound & {v.name for v in victims}
+
+        # the 51st executor has no spot
+        extra = h.static_allocation_spark_pods("app-storm", 1)[1]
+        extra.meta.name = "app-storm-extra"
+        h.assert_failure(h.schedule(extra, nodes))
+    finally:
+        h.close()
