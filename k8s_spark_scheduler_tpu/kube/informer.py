@@ -18,7 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..types.objects import APIObject, Pod
+from ..types.objects import APIObject
 from .apiserver import ADDED, APIServer, DELETED, MODIFIED
 
 Handler = Callable[[APIObject], None]

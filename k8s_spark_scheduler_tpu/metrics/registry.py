@@ -12,7 +12,7 @@ import math
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, List, Tuple
 
 TagSet = Tuple[Tuple[str, str], ...]
 

@@ -21,7 +21,6 @@ import time
 from typing import List, Optional
 
 from ..scheduler import labels as L
-from ..types.objects import Pod
 from ..types.resources import Resources
 from . import names
 from .registry import MetricsRegistry

@@ -31,7 +31,7 @@ compatible, with the node axis shardable over a device mesh.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

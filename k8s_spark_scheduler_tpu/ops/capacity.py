@@ -7,7 +7,7 @@ Exact floor division over Fractions reproduces the reference's
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Sequence
+from typing import List, Sequence
 
 from ..types.resources import (
     NodeGroupResources,

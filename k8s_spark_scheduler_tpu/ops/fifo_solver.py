@@ -12,11 +12,11 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 
-from ..types.resources import NodeGroupSchedulingMetadata, Resources
+from ..types.resources import NodeGroupSchedulingMetadata
 from .batch_adapter import (
     build_reserved,
     counts_to_evenly_list,

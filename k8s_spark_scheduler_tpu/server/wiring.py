@@ -9,8 +9,6 @@ InitServerWithClients.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
-
 from ..config import Install
 from ..demands.manager import DemandManager
 from ..events.events import EventLog

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -25,7 +25,6 @@ from . import store as _store
 from .store import (
     CREATE,
     DELETE,
-    Key,
     ObjectStore,
     Request,
     ShardedUniqueQueue,

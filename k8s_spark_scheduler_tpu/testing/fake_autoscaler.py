@@ -12,8 +12,6 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Optional
-
 from ..kube.apiserver import APIServer
 from ..kube.informer import Informer
 from ..types.objects import Demand, DemandPhase, Node, ObjectMeta

@@ -12,7 +12,7 @@ Demand v1alpha1 ↔ v1alpha2 (flat resources vs resource list).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from ..utils.quantity import Quantity
 from .extenderapi import ExtenderArgs, ExtenderFilterResult
@@ -29,7 +29,7 @@ from .objects import (
     ResourceReservationSpec,
     ResourceReservationStatus,
 )
-from .resources import RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_NVIDIA_GPU, Resources
+from .resources import RESOURCE_CPU, RESOURCE_MEMORY, Resources
 
 GROUP_NAME = "sparkscheduler.palantir.com"
 RESERVATION_SPEC_ANNOTATION_KEY = GROUP_NAME + "/reservation-spec"
