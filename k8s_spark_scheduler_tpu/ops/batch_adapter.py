@@ -308,6 +308,21 @@ def tpu_batch_min_frag_binpacker() -> Binpacker:
     )
 
 
+def candidate_zone_masks(driver_order, executor_order, metadata, names, nb):
+    """Zone ordering + per-zone node masks shared by the single-AZ gang
+    and FIFO device paths (single_az.go:30-45 first-appearance order;
+    zones without executor candidates are dropped)."""
+    driver_zones_in_order, _ = packers.group_nodes_by_zone(driver_order, metadata)
+    _, executor_by_zone = packers.group_nodes_by_zone(executor_order, metadata)
+    candidate_zones = [z for z in driver_zones_in_order if z in executor_by_zone]
+    zone_of = {name: metadata[name].zone_label for name in names}
+    zone_masks = np.zeros((max(len(candidate_zones), 1), nb), dtype=bool)
+    for zi, zone in enumerate(candidate_zones):
+        for i, name in enumerate(names):
+            zone_masks[zi, i] = zone_of[name] == zone
+    return candidate_zones, zone_masks
+
+
 class TpuSingleAzBinpacker:
     """Single-AZ combinator on device (single_az.go:23-55): all zones
     solved in one vmapped call, zone chosen on host with the oracle's
@@ -352,24 +367,12 @@ class TpuSingleAzBinpacker:
                 metadata,
             )
 
-        # zone ordering and per-zone executor availability follow the
-        # driver list's first-appearance order (single_az.go:30-45)
-        driver_zones_in_order, _ = packers.group_nodes_by_zone(
-            driver_node_priority_order, metadata
-        )
-        _, executor_by_zone = packers.group_nodes_by_zone(
-            executor_node_priority_order, metadata
-        )
-        candidate_zones = [z for z in driver_zones_in_order if z in executor_by_zone]
-
         names = cluster.node_names
         n = len(names)
         nb = problem.avail.shape[0]
-        zone_of = {name: metadata[name].zone_label for name in names}
-        zone_masks = np.zeros((max(len(candidate_zones), 1), nb), dtype=bool)
-        for zi, zone in enumerate(candidate_zones):
-            for i, name in enumerate(names):
-                zone_masks[zi, i] = zone_of[name] == zone
+        candidate_zones, zone_masks = candidate_zone_masks(
+            driver_node_priority_order, executor_node_priority_order, metadata, names, nb
+        )
 
         solves = solve_zones_jit(
             jnp.asarray(problem.avail),
@@ -426,16 +429,22 @@ class TpuSingleAzBinpacker:
 
 
 def tpu_batch_single_az_binpacker() -> Binpacker:
+    from .fifo_solver import TpuSingleAzFifoSolver
+
     return Binpacker(
         name="tpu-batch-single-az",
         binpack_func=TpuSingleAzBinpacker(az_aware=False),
         is_single_az=True,
+        queue_solver=TpuSingleAzFifoSolver(az_aware=False),
     )
 
 
 def tpu_batch_az_aware_binpacker() -> Binpacker:
+    from .fifo_solver import TpuSingleAzFifoSolver
+
     return Binpacker(
         name="tpu-batch-az-aware",
         binpack_func=TpuSingleAzBinpacker(az_aware=True),
         is_single_az=True,
+        queue_solver=TpuSingleAzFifoSolver(az_aware=True),
     )

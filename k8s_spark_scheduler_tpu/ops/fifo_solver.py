@@ -190,3 +190,173 @@ class TpuFifoSolver:
             packing_efficiencies=efficiencies,
         )
         return FifoOutcome(supported=True, earlier_ok=True, result=result)
+
+
+class TpuSingleAzFifoSolver:
+    """FIFO pass for the single-AZ policies: each earlier driver's
+    per-zone gang solves run in ONE vmapped device call (solve_zones);
+    the zone choice (float64 efficiency, oracle functions) and the
+    carried usage subtraction (exact scaled ints with the reference's
+    overwrite quirk) run on host.  az_aware adds the cross-zone fallback
+    for each driver (az_aware_pack_tightly.go:27-38)."""
+
+    def __init__(self, az_aware: bool = False):
+        self.az_aware = az_aware
+
+    def solve(
+        self,
+        metadata: NodeGroupSchedulingMetadata,
+        driver_order: Sequence[str],
+        executor_order: Sequence[str],
+        earlier_apps: List[AppDemand],
+        earlier_skip_allowed: List[bool],
+        current_app: AppDemand,
+    ) -> FifoOutcome:
+        import jax.numpy as jnp
+
+        from . import packers
+        from .batch_solver import solve_zones_jit
+
+        cluster = tensorize_cluster(metadata, driver_order, executor_order)
+        all_apps = list(earlier_apps) + [current_app]
+        apps = tensorize_apps(all_apps)
+        problem = scale_problem(cluster, apps)
+        if not problem.ok:
+            return FifoOutcome(supported=False)
+
+        names = cluster.node_names
+        n = len(names)
+        nb = problem.avail.shape[0]
+        scale = problem.scale.astype(np.int64)
+
+        from .batch_adapter import candidate_zone_masks
+
+        candidate_zones, zone_masks = candidate_zone_masks(
+            driver_order, executor_order, metadata, names, nb
+        )
+        zone_masks_dev = jnp.asarray(zone_masks)
+        rank_dev = jnp.asarray(problem.driver_rank)
+        exec_dev = jnp.asarray(problem.exec_ok)
+
+        avail = problem.avail.astype(np.int32).copy()  # scaled, mutated per driver
+
+        def pack_one(app_idx: int):
+            """Device zone solves + host zone choice for one app.
+            Returns (driver_idx, counts) or None when infeasible."""
+            if not candidate_zones:
+                return None  # no zone has both driver and executor candidates
+            solves = solve_zones_jit(
+                jnp.asarray(avail),
+                rank_dev,
+                exec_dev,
+                zone_masks_dev,
+                jnp.asarray(problem.driver[app_idx]),
+                jnp.asarray(problem.executor[app_idx]),
+                jnp.asarray(problem.count[app_idx]),
+            )
+            feasible = np.asarray(solves.feasible)
+            driver_idx = np.asarray(solves.driver_idx)
+            counts_all = np.asarray(solves.exec_counts)
+
+            results = []
+            per_zone = []
+            for zi, zone in enumerate(candidate_zones):
+                if not feasible[zi]:
+                    continue
+                d_idx = int(driver_idx[zi])
+                zone_counts = counts_all[zi][:n]
+                results.append(
+                    PackingResult(
+                        driver_node=names[d_idx],
+                        executor_nodes=counts_to_tightly_list(names, zone_counts),
+                        has_capacity=True,
+                        packing_efficiencies=efficiencies_from_rows(
+                            names,
+                            cluster.sched,
+                            avail.astype(np.int64) * scale[None, :],
+                            _reserved_rows(
+                                n, d_idx, zone_counts, problem, app_idx
+                            ) * scale[None, :],
+                        ),
+                    )
+                )
+                per_zone.append((d_idx, zone_counts))
+            if not results:
+                return None
+            best = packers._choose_best_result(metadata, results)
+            if not best.has_capacity:
+                # the all-zero-efficiency quirk: single-az yields nothing;
+                # the caller's az_aware fallback handles the cross-zone pack
+                return None
+            choice = results.index(best)
+            return per_zone[choice]
+
+        def plain_fallback(app_idx):
+            return self._plain_pack(app_idx, avail, problem, n)
+
+        for i, app in enumerate(earlier_apps):
+            packed = pack_one(i)
+            if packed is None and self.az_aware:
+                packed = plain_fallback(i)
+            if packed is None:
+                if earlier_skip_allowed[i]:
+                    continue
+                return FifoOutcome(supported=True, earlier_ok=False)
+            d_idx, counts = packed
+            self._subtract(avail, d_idx, counts, problem, i, n)
+
+        packed = pack_one(len(earlier_apps))
+        if packed is None and self.az_aware:
+            packed = plain_fallback(len(earlier_apps))
+        if packed is None:
+            return FifoOutcome(supported=True, earlier_ok=True, result=empty_packing_result())
+        d_idx, counts = packed
+        result = PackingResult(
+            driver_node=names[d_idx],
+            executor_nodes=counts_to_tightly_list(names, counts),
+            has_capacity=True,
+            packing_efficiencies=efficiencies_from_rows(
+                names,
+                cluster.sched,
+                avail.astype(np.int64) * scale[None, :],
+                _reserved_rows(n, d_idx, counts, problem, len(earlier_apps))
+                * scale[None, :],
+            ),
+        )
+        return FifoOutcome(supported=True, earlier_ok=True, result=result)
+
+    @staticmethod
+    def _plain_pack(app_idx, avail, problem, n):
+        """Cross-zone tightly-pack (the az-aware fallback)."""
+        import jax.numpy as jnp
+
+        from .batch_solver import solve_single
+
+        solve = solve_single(
+            jnp.asarray(avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(problem.driver[app_idx]),
+            jnp.asarray(problem.executor[app_idx]),
+            jnp.asarray(problem.count[app_idx]),
+        )
+        if not bool(solve.feasible):
+            return None
+        return int(solve.driver_idx), np.asarray(solve.exec_counts)[:n]
+
+    @staticmethod
+    def _subtract(avail, d_idx, counts, problem, app_idx, n):
+        """The reference's usage-overwrite quirk in scaled int space."""
+        exec_mask = counts > 0
+        delta = np.zeros((avail.shape[0], 3), np.int32)
+        delta[:n][exec_mask] = problem.executor[app_idx]
+        if not exec_mask[d_idx]:
+            delta[d_idx] = problem.driver[app_idx]
+        avail -= delta
+
+
+def _reserved_rows(n, d_idx, counts, problem, app_idx):
+    rows = np.zeros((n, 3), np.int64)
+    rows += counts.astype(np.int64)[:, None] * problem.executor[app_idx].astype(np.int64)[None, :]
+    rows[d_idx] += problem.driver[app_idx].astype(np.int64)
+    return rows

@@ -392,7 +392,14 @@ class SparkSchedulerExtender:
         event-driven tensor snapshot: zero Quantity arithmetic.  Returns
         (FifoOutcome, zones) or None to use the Quantity path."""
         solver = getattr(self.binpacker, "queue_solver", None)
-        if solver is None or not self._fast_path_ok:
+        # the tensor-snapshot lane needs a solver that accepts prebuilt
+        # tensors; the single-AZ FIFO solver requires Quantity metadata
+        # (zone efficiency choice) and goes through the metadata path
+        if (
+            solver is None
+            or not hasattr(solver, "solve_tensor")
+            or not self._fast_path_ok
+        ):
             return None
         try:
             from ..ops.fast_path import build_cluster_tensor

@@ -132,3 +132,59 @@ def test_extender_tpu_batch_gang_semantics_match_tightly():
         finally:
             h.close()
     assert results["tightly-pack"] == results["tpu-batch"]
+
+
+def host_single_az_fifo_oracle(
+    metadata, driver_order, executor_order, earlier, skip_allowed, current, az_aware
+):
+    """The extender's host loop with the single-AZ oracles."""
+    oracle = packers.az_aware_tightly_pack if az_aware else packers.single_az_tightly_pack
+    meta = copy_metadata(metadata)
+    for app, skippable in zip(earlier, skip_allowed):
+        result = oracle(
+            app.driver_resources, app.executor_resources, app.min_executor_count,
+            driver_order, executor_order, meta,
+        )
+        if not result.has_capacity:
+            if skippable:
+                continue
+            return False, None
+        subtract_usage_if_exists(
+            meta,
+            spark_resource_usage(
+                app.driver_resources, app.executor_resources,
+                result.driver_node, result.executor_nodes,
+            ),
+        )
+    return True, oracle(
+        current.driver_resources, current.executor_resources,
+        current.min_executor_count, driver_order, executor_order, meta,
+    )
+
+
+@pytest.mark.parametrize("az_aware", [False, True])
+def test_single_az_fifo_solver_parity(az_aware):
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import TpuSingleAzFifoSolver
+
+    rng = random.Random(60606 + az_aware)
+    solver = TpuSingleAzFifoSolver(az_aware=az_aware)
+    for trial in range(20):
+        metadata = random_cluster(rng, rng.randint(2, 18))
+        driver_order, executor_order = orders_for(metadata, rng)
+        earlier = [random_app(rng) for _ in range(rng.randint(0, 6))]
+        skip_allowed = [rng.random() < 0.3 for _ in earlier]
+        current = random_app(rng)
+
+        expected_ok, expected = host_single_az_fifo_oracle(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current, az_aware
+        )
+        outcome = solver.solve(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        assert outcome.supported
+        assert outcome.earlier_ok == expected_ok, f"trial {trial}: earlier_ok"
+        if expected_ok:
+            assert outcome.result.has_capacity == expected.has_capacity, f"trial {trial}"
+            if expected.has_capacity:
+                assert outcome.result.driver_node == expected.driver_node, f"trial {trial}"
+                assert outcome.result.executor_nodes == expected.executor_nodes, f"trial {trial}"
