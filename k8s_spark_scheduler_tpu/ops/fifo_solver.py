@@ -19,6 +19,7 @@ import numpy as np
 from ..types.resources import NodeGroupSchedulingMetadata
 from .batch_adapter import (
     build_reserved,
+    candidate_zone_masks,
     counts_to_evenly_list,
     counts_to_tightly_list,
     evenly_counts,
@@ -229,8 +230,6 @@ class TpuSingleAzFifoSolver:
         nb = problem.avail.shape[0]
         scale = problem.scale.astype(np.int64)
 
-        from .batch_adapter import candidate_zone_masks
-
         candidate_zones, zone_masks = candidate_zone_masks(
             driver_order, executor_order, metadata, names, nb
         )
@@ -289,7 +288,8 @@ class TpuSingleAzFifoSolver:
                 # the caller's az_aware fallback handles the cross-zone pack
                 return None
             choice = results.index(best)
-            return per_zone[choice]
+            d_idx, counts = per_zone[choice]
+            return d_idx, counts, best
 
         def plain_fallback(app_idx):
             return self._plain_pack(app_idx, avail, problem, n)
@@ -297,33 +297,37 @@ class TpuSingleAzFifoSolver:
         for i, app in enumerate(earlier_apps):
             packed = pack_one(i)
             if packed is None and self.az_aware:
-                packed = plain_fallback(i)
+                fallback = plain_fallback(i)
+                packed = fallback if fallback is None else (*fallback, None)
             if packed is None:
                 if earlier_skip_allowed[i]:
                     continue
                 return FifoOutcome(supported=True, earlier_ok=False)
-            d_idx, counts = packed
+            d_idx, counts = packed[0], packed[1]
             self._subtract(avail, d_idx, counts, problem, i, n)
 
         packed = pack_one(len(earlier_apps))
         if packed is None and self.az_aware:
-            packed = plain_fallback(len(earlier_apps))
+            fallback = plain_fallback(len(earlier_apps))
+            packed = fallback if fallback is None else (*fallback, None)
         if packed is None:
             return FifoOutcome(supported=True, earlier_ok=True, result=empty_packing_result())
-        d_idx, counts = packed
-        result = PackingResult(
-            driver_node=names[d_idx],
-            executor_nodes=counts_to_tightly_list(names, counts),
-            has_capacity=True,
-            packing_efficiencies=efficiencies_from_rows(
-                names,
-                cluster.sched,
-                avail.astype(np.int64) * scale[None, :],
-                _reserved_rows(n, d_idx, counts, problem, len(earlier_apps))
-                * scale[None, :],
-            ),
-        )
-        return FifoOutcome(supported=True, earlier_ok=True, result=result)
+        d_idx, counts, chosen = packed
+        if chosen is None:
+            # cross-zone fallback path: build the result from counts
+            chosen = PackingResult(
+                driver_node=names[d_idx],
+                executor_nodes=counts_to_tightly_list(names, counts),
+                has_capacity=True,
+                packing_efficiencies=efficiencies_from_rows(
+                    names,
+                    cluster.sched,
+                    avail.astype(np.int64) * scale[None, :],
+                    _reserved_rows(n, d_idx, counts, problem, len(earlier_apps))
+                    * scale[None, :],
+                ),
+            )
+        return FifoOutcome(supported=True, earlier_ok=True, result=chosen)
 
     @staticmethod
     def _plain_pack(app_idx, avail, problem, n):

@@ -193,6 +193,21 @@ def init_server_with_clients(
         waste_reporter=waste_reporter,
     )
     server.reporters = ReporterSet(server)
+
+    from ..scheduler import invariants
+
+    if invariants.enabled():
+        # wrap INSIDE the predicate lock so the check always sees
+        # quiesced post-predicate state (no races with a concurrent
+        # Filter call mid-mutation)
+        original = extender._predicate_locked
+
+        def checked_predicate_locked(args):
+            result = original(args)
+            invariants.check(server, raise_on_violation=False)
+            return result
+
+        extender._predicate_locked = checked_predicate_locked
     if start_background:
         server.start_background()
     return server

@@ -1,0 +1,51 @@
+"""Debug invariant checker tests."""
+
+import random
+
+from k8s_spark_scheduler_tpu.scheduler import invariants
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+
+def test_invariants_hold_through_churn():
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        rng = random.Random(123)
+        for i in range(4):
+            h.new_node(f"n{i}")
+        nodes = [f"n{i}" for i in range(4)]
+        live = []
+        for step in range(30):
+            if rng.random() < 0.6 or not live:
+                pods = h.static_allocation_spark_pods(f"a{step}", rng.randint(1, 3))
+                if h.schedule(pods[0], nodes).node_names:
+                    placed = [pods[0]]
+                    for p in pods[1:]:
+                        if h.schedule(p, nodes).node_names:
+                            placed.append(p)
+                    live.append(placed)
+            else:
+                for p in live.pop(rng.randrange(len(live))):
+                    try:
+                        h.delete_pod(p)
+                    except Exception:
+                        pass
+                h.wait_quiesced()
+            assert invariants.check(h.server) == []
+    finally:
+        h.close()
+
+
+def test_invariants_catch_corruption():
+    h = Harness()
+    try:
+        h.new_node("n1")
+        pods = h.static_allocation_spark_pods("app-c", 1)
+        h.assert_success(h.schedule(pods[0], ["n1"]))
+        # corrupt: bind a pod to a nonexistent reservation name
+        rr = h.server.resource_reservation_cache.get("default", "app-c").deepcopy()
+        rr.status.pods["executor-99"] = "ghost"
+        h.server.resource_reservation_cache.update(rr)
+        violations = invariants.check(h.server, raise_on_violation=False)
+        assert any(v.startswith("I1") for v in violations)
+    finally:
+        h.close()
