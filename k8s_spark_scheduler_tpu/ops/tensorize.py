@@ -58,7 +58,11 @@ def _resources_to_base(r: Resources) -> Tuple[List[int], bool]:
     return out, exact
 
 
-def bucket_size(n: int, buckets: Sequence[int] = (64, 256, 1024, 4096)) -> int:
+NODE_BUCKETS = (64, 256, 1024, 4096)
+APP_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
+def bucket_size(n: int, buckets: Sequence[int] = NODE_BUCKETS) -> int:
     """Pad to a bounded set of shapes: fixed small buckets, then
     multiples of 1024 (TPU-lane friendly without 60% padding waste at
     the 10k-node scale)."""
@@ -202,7 +206,7 @@ def scale_problem(
     """GCD-scale each dimension to int32 and pad to bucket shapes."""
     n, a = cluster.avail.shape[0], apps.driver.shape[0]
     nb = node_bucket or bucket_size(n)
-    ab = app_bucket or bucket_size(a, buckets=(16, 64, 256, 1024, 4096))
+    ab = app_bucket or bucket_size(a, buckets=APP_BUCKETS)
 
     ok = cluster.exact and apps.exact
     scale = np.ones(DIMS, dtype=np.int64)

@@ -69,6 +69,52 @@ class Server:
         self.unschedulable_marker.start()
         if self.reporters is not None:
             self.reporters.start()
+        self._warm_solver_async()
+
+    def _warm_solver_async(self) -> None:
+        """Pre-compile the device solver kernels for the common shape
+        buckets in the background so the first Filter request doesn't
+        pay jit latency (first compile is seconds on TPU)."""
+        if not self.extender.binpacker.name.startswith("tpu-batch"):
+            return
+
+        def warm():
+            try:
+                import jax.numpy as jnp
+
+                from ..ops.batch_solver import solve_queue, solve_single
+                from ..ops.tensorize import APP_BUCKETS, NODE_BUCKETS
+
+                for nb in NODE_BUCKETS[:3]:  # the shapes real clusters hit first
+                    avail = jnp.zeros((nb, 3), jnp.int32)
+                    rank = jnp.full((nb,), 2**31 - 1, jnp.int32)
+                    eok = jnp.zeros((nb,), bool)
+                    row = jnp.zeros((3,), jnp.int32)
+                    solve_single(avail, rank, eok, row, row, jnp.int32(0))
+                    # the FIFO path's first-called kernel (smallest app bucket)
+                    ab = APP_BUCKETS[0]
+                    solve_queue(
+                        avail,
+                        rank,
+                        eok,
+                        jnp.zeros((ab, 3), jnp.int32),
+                        jnp.zeros((ab, 3), jnp.int32),
+                        jnp.zeros((ab,), jnp.int32),
+                        jnp.zeros((ab,), bool),
+                        evenly=False,
+                        with_placements=False,
+                    )
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "solver warmup failed; first request will compile",
+                    exc_info=True,
+                )
+
+        import threading
+
+        threading.Thread(target=warm, daemon=True, name="solver-warmup").start()
 
     def stop(self) -> None:
         if self.reporters is not None:
