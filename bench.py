@@ -86,18 +86,10 @@ def _probe_tpu_backend(timeout_s: float = 180.0) -> bool:
     """The dev TPU sits behind a relay that can wedge; probing backend
     init in a subprocess keeps this process unblocked.  Returns True when
     the TPU backend is usable."""
-    import subprocess
+    from k8s_spark_scheduler_tpu.utils.tpuprobe import probe_default_backend
 
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-            capture_output=True,
-            timeout=timeout_s,
-            text=True,
-        )
-        return probe.returncode == 0 and "tpu" in probe.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    backend = probe_default_backend(timeout_s)
+    return backend is not None and "tpu" in backend
 
 
 def main() -> None:

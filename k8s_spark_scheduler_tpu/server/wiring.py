@@ -74,7 +74,13 @@ class Server:
     def _warm_solver_async(self) -> None:
         """Pre-compile the device solver kernels for the common shape
         buckets in the background so the first Filter request doesn't
-        pay jit latency (first compile is seconds on TPU)."""
+        pay jit latency (first compile is seconds on TPU).
+
+        The thread is joined (bounded) in stop(): a daemon thread killed
+        mid-XLA-compile at interpreter shutdown aborts the whole process
+        ("FATAL: exception not rethrown" from pthread teardown inside
+        the compiler).  It stays a daemon thread so a compile wedged on
+        a dead device can never block process exit outright."""
         if not self.extender.binpacker.name.startswith("tpu-batch"):
             return
 
@@ -86,6 +92,8 @@ class Server:
                 from ..ops.tensorize import APP_BUCKETS, NODE_BUCKETS
 
                 for nb in NODE_BUCKETS[:3]:  # the shapes real clusters hit first
+                    if self._warm_stop.is_set():
+                        return
                     avail = jnp.zeros((nb, 3), jnp.int32)
                     rank = jnp.full((nb,), 2**31 - 1, jnp.int32)
                     eok = jnp.zeros((nb,), bool)
@@ -114,14 +122,32 @@ class Server:
 
         import threading
 
-        threading.Thread(target=warm, daemon=True, name="solver-warmup").start()
+        self._warm_stop = threading.Event()
+        self._warm_thread = threading.Thread(
+            target=warm, daemon=True, name="solver-warmup"
+        )
+        self._warm_thread.start()
 
     def stop(self) -> None:
+        warm_thread = getattr(self, "_warm_thread", None)
+        if warm_thread is not None:
+            self._warm_stop.set()  # signal first; join after the other stops
         if self.reporters is not None:
             self.reporters.stop()
         self.unschedulable_marker.stop()
         self.resource_reservation_cache.stop()
         self.demand_cache.stop()
+        if warm_thread is not None:
+            # a healthy compile finishes in seconds; a wedged device must
+            # not hang shutdown, so give up after the timeout (the daemon
+            # flag then lets the process exit, at worst uncleanly)
+            warm_thread.join(timeout=120)
+            if warm_thread.is_alive():
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "solver warmup still compiling after 120s; abandoning it"
+                )
 
 
 def init_server_with_clients(

@@ -1,0 +1,76 @@
+"""Subprocess probe for a wedge-prone accelerator backend.
+
+The dev TPU here sits behind a relay whose backend init can block
+forever (uninterruptibly — even SIGKILL may not collect the child).
+Probing in a detached subprocess with a poll loop keeps the calling
+process unblocked no matter what the child does.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional
+
+_PROBE_SRC = "import jax; print(jax.default_backend())"
+
+
+def probe_default_backend(timeout_s: float = 120.0) -> Optional[str]:
+    """Return the default jax backend name ("tpu", "cpu", ...), or None
+    when backend init hangs past ``timeout_s`` or exits nonzero.
+
+    Uses Popen + a poll loop — never a blocking wait — because a wedged
+    child can sit in uninterruptible device I/O where ``communicate()``
+    after kill() blocks forever too.
+    """
+    with tempfile.TemporaryFile() as outf, tempfile.TemporaryFile() as errf:
+        child = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC],
+            stdout=outf,
+            stderr=errf,
+            start_new_session=True,  # keep terminal signals away from it
+        )
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and child.poll() is None:
+            time.sleep(0.5)
+        code = child.poll()
+        if code is None:
+            child.kill()
+            try:  # reap if the kill lands; wait(timeout) polls, never blocks
+                child.wait(timeout=1)
+            except subprocess.TimeoutExpired:
+                pass
+            print(
+                f"backend probe hung past {timeout_s:.0f}s (relay wedged?)",
+                file=sys.stderr,
+            )
+            return None
+        if code != 0:
+            errf.seek(0)
+            print(
+                "backend probe failed:\n"
+                + errf.read().decode(errors="replace")[-500:],
+                file=sys.stderr,
+            )
+            return None
+        outf.seek(0)
+        return outf.read().decode(errors="replace").strip() or None
+
+
+def live_platforms() -> str:
+    """The effective jax_platforms value: the live config (authoritative —
+    this container's sitecustomize pins it via jax.config.update, which
+    env vars cannot override after import) falling back to the env var
+    for processes where jax reads JAX_PLATFORMS at import normally."""
+    try:
+        import jax
+
+        live = getattr(jax.config, "jax_platforms", None)
+    except Exception:
+        live = None
+    if live:
+        return str(live)
+    return os.environ.get("JAX_PLATFORMS", "") or ""
