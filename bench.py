@@ -85,9 +85,16 @@ def build_problem():
 def _probe_tpu_backend(timeout_s: float = 180.0) -> bool:
     """The dev TPU sits behind a relay that can wedge; probing backend
     init in a subprocess keeps this process unblocked.  Returns True when
-    the TPU backend is usable."""
-    from k8s_spark_scheduler_tpu.utils.tpuprobe import probe_default_backend
+    the TPU backend is usable.  Skips the (multi-second) probe entirely
+    when no non-CPU platform is configured."""
+    from k8s_spark_scheduler_tpu.utils.tpuprobe import (
+        live_platforms,
+        probe_default_backend,
+    )
 
+    platforms = live_platforms()
+    if not platforms or platforms.split(",")[0].strip() == "cpu":
+        return False
     backend = probe_default_backend(timeout_s)
     return backend is not None and "tpu" in backend
 
@@ -98,7 +105,8 @@ def main() -> None:
     import jax
 
     if not tpu_usable:
-        print("# TPU backend unusable (relay wedged?); benching on CPU", file=sys.stderr)
+        # tpuprobe prints the "relay wedged?" hint itself when the probe hangs
+        print("# TPU backend unavailable; benching on CPU", file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp

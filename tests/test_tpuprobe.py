@@ -1,0 +1,71 @@
+"""Unit tests for the wedge-safe backend probe (utils/tpuprobe.py) and
+the solver-warmup thread lifecycle it protects (server/wiring.py).
+
+The real probe child imports jax (slow, and hangs when the dev relay is
+wedged); these tests swap the probe source for tiny deterministic
+programs so each scenario — healthy, failing, hung — runs in
+milliseconds and is independent of device state.
+"""
+
+import threading
+import time
+
+from k8s_spark_scheduler_tpu.utils import tpuprobe
+
+
+def test_probe_returns_backend_name(monkeypatch):
+    monkeypatch.setattr(tpuprobe, "_PROBE_SRC", "print('cpu')")
+    assert tpuprobe.probe_default_backend(10.0) == "cpu"
+
+
+def test_probe_nonzero_exit_returns_none(monkeypatch, capsys):
+    monkeypatch.setattr(
+        tpuprobe, "_PROBE_SRC", "import sys; print('boom', file=sys.stderr); sys.exit(3)"
+    )
+    assert tpuprobe.probe_default_backend(10.0) is None
+    assert "boom" in capsys.readouterr().err
+
+
+def test_probe_hang_times_out_and_reaps(monkeypatch, capsys):
+    monkeypatch.setattr(tpuprobe, "_PROBE_SRC", "import time; time.sleep(60)")
+    t0 = time.monotonic()
+    assert tpuprobe.probe_default_backend(1.0) is None
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10, f"timeout path took {elapsed:.1f}s"
+    assert "hung" in capsys.readouterr().err
+
+
+def test_probe_empty_output_is_none(monkeypatch):
+    monkeypatch.setattr(tpuprobe, "_PROBE_SRC", "pass")
+    assert tpuprobe.probe_default_backend(10.0) is None
+
+
+def test_live_platforms_prefers_live_config():
+    # the suite's conftest pins the live config to cpu; the env var must
+    # not be consulted when the live config is set
+    assert tpuprobe.live_platforms().split(",")[0].strip() == "cpu"
+
+
+def test_warmup_thread_joined_on_stop():
+    """stop() must leave no warmup thread running: a thread killed
+    mid-XLA-compile at interpreter exit aborts the process."""
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        warm = getattr(h.server, "_warm_thread", None)
+        assert warm is not None, "tpu-batch server must start solver warmup"
+    finally:
+        h.close()
+    assert not warm.is_alive()
+    assert not any(t.name == "solver-warmup" for t in threading.enumerate())
+
+
+def test_no_warmup_thread_for_host_policies():
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+    h = Harness(binpack_algo="tightly-pack")
+    try:
+        assert getattr(h.server, "_warm_thread", None) is None
+    finally:
+        h.close()
