@@ -136,6 +136,27 @@ class SparkSchedulerExtender:
 
     def _predicate_locked(self, args: ExtenderArgs) -> ExtenderFilterResult:
         pod = args.pod
+        # the wire pod is authoritative for spec/labels, but reservation
+        # owner references need the cluster UID: a UID-less wire pod
+        # (kube-scheduler always sends one; simulators may not) would
+        # create reservations the owner GC can never match — a permanent
+        # capacity leak
+        if not pod.meta.uid:
+            stored = self._pod_lister.informer.get(pod.namespace, pod.name)
+            if stored is None:
+                # kube-scheduler always sends the UID and only schedules
+                # pods that exist; a UID-less pod unknown to the informer
+                # is a broken client — reject rather than create an
+                # owner-less (uncollectable) reservation
+                logger.warning(
+                    "rejecting pod %s/%s: no UID and not in the informer",
+                    pod.namespace,
+                    pod.name,
+                )
+                return self._fail_with_message(
+                    FAILURE_INTERNAL, args, "pod has no UID and is unknown"
+                )
+            pod.meta.uid = stored.meta.uid
         role = pod.labels.get(L.SPARK_ROLE_LABEL, "")
         instance_group, ok = L.find_instance_group_from_pod_spec(pod, self._instance_group_label)
         if not ok:

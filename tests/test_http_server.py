@@ -324,3 +324,53 @@ def test_request_tracing_header(served):
     )
     with urllib.request.urlopen(req, timeout=10) as resp:
         assert resp.headers.get("X-Trace-Id")
+
+
+def test_uid_less_wire_pod_reservation_still_gcd(served):
+    """A pod POSTed without metadata.uid (kube-scheduler always sends
+    one; simulators may not) must not produce a reservation whose owner
+    reference the GC can never match — that would leak held capacity
+    forever.  The extender backfills the UID from its informer."""
+    api, scheduler, http = served
+    _create_nodes(api)
+
+    driver_json, _ = _driver_pod_json("app-no-uid")
+    api.create(serde.pod_from_dict(driver_json))
+    assert not driver_json["metadata"].get("uid")  # wire pod is UID-less
+
+    status, result = _post(
+        http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]}
+    )
+    assert status == 200 and result["NodeNames"]
+
+    deadline = time.time() + 5
+    while time.time() < deadline and not api.list("ResourceReservation"):
+        time.sleep(0.01)
+    rr = api.list("ResourceReservation")[0]
+    stored = api.get("Pod", "default", "app-no-uid-driver")
+    assert rr.meta.owner_references[0].uid == stored.meta.uid
+
+    # owner GC collects the reservation when the driver goes away
+    api.delete("Pod", "default", stored.name)
+    deadline = time.time() + 5
+    while time.time() < deadline and api.list("ResourceReservation"):
+        time.sleep(0.01)
+    assert not api.list("ResourceReservation")
+
+
+def test_uid_less_unknown_pod_rejected(served):
+    """A UID-less pod the informer has never seen must be rejected
+    (FAILURE result), not granted a reservation no GC can ever collect."""
+    api, scheduler, http = served
+    _create_nodes(api)
+
+    driver_json, _ = _driver_pod_json("app-ghost")
+    # deliberately NOT created in the API server
+    status, result = _post(
+        http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]}
+    )
+    assert status == 200
+    assert not result.get("NodeNames")
+    assert result["FailedNodes"]
+    time.sleep(0.2)
+    assert not api.list("ResourceReservation")
