@@ -1,0 +1,63 @@
+"""Metric-name catalog contract (reference internal/metrics/metrics.go:30-68).
+
+Dashboards and alerts key on these exact strings; a rename is a silent
+observability outage, so the catalog is pinned here."""
+
+from k8s_spark_scheduler_tpu.metrics import names as M
+
+
+def _catalog():
+    return {
+        k: v
+        for k, v in vars(M).items()
+        if k.isupper() and not k.startswith("TAG_") and isinstance(v, str)
+    }
+
+
+def test_all_metric_names_namespaced():
+    for const, name in _catalog().items():
+        assert name.startswith("foundry.spark.scheduler."), (const, name)
+
+
+def test_catalog_unique_and_complete():
+    catalog = _catalog()
+    values = list(catalog.values())
+    assert len(values) == len(set(values)), "duplicate metric names"
+    # the reference's full set (metrics.go:30-68); anything missing here
+    # breaks an existing dashboard
+    expected = {
+        "foundry.spark.scheduler.requests",
+        "foundry.spark.scheduler.schedule.time",
+        "foundry.spark.scheduler.reconciliation.time",
+        "foundry.spark.scheduler.wait.time",
+        "foundry.spark.scheduler.retry.time",
+        "foundry.spark.scheduler.resource.usage.cpu",
+        "foundry.spark.scheduler.resource.usage.memory",
+        "foundry.spark.scheduler.resource.usage.nvidia.com/gpu",
+        "foundry.spark.scheduler.pod.lifecycle.max",
+        "foundry.spark.scheduler.pod.lifecycle.p95",
+        "foundry.spark.scheduler.pod.lifecycle.p50",
+        "foundry.spark.scheduler.pod.lifecycle.count",
+        "foundry.spark.scheduler.cache.objects.count",
+        "foundry.spark.scheduler.cache.inflight.count",
+        "foundry.spark.scheduler.reservations.unbound.cpu",
+        "foundry.spark.scheduler.reservations.unbound.memory",
+        "foundry.spark.scheduler.reservations.unbound.nvidiagpu",
+        "foundry.spark.scheduler.reservations.timetofirstbind",
+        "foundry.spark.scheduler.softreservation.count",
+        "foundry.spark.scheduler.softreservation.executorcount",
+        "foundry.spark.scheduler.softreservation.executorswithnoreservations",
+        "foundry.spark.scheduler.informer.delay",
+        "foundry.spark.scheduler.scheduling.waste",
+        "foundry.spark.scheduler.packing.efficiency",
+    }
+    missing = expected - set(values)
+    assert not missing, f"reference metric names missing: {missing}"
+
+
+def test_tag_keys_match_reference():
+    # metrics.go:70-85
+    assert M.TAG_SPARK_ROLE == "sparkrole"
+    assert M.TAG_OUTCOME == "outcome"
+    assert M.TAG_INSTANCE_GROUP == "instance-group"
+    assert M.TAG_LIFECYCLE == "lifecycle"
