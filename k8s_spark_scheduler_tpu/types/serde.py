@@ -100,12 +100,14 @@ def pod_from_dict(d: dict) -> Pod:
         node_affinity = {k: v for k, _, v in affinity_terms[0]}
         affinity_terms = []
 
-    containers = []
-    for c in spec.get("containers") or []:
-        requests = (c.get("resources") or {}).get("requests") or {}
-        containers.append(
-            Container(name=c.get("name", "main"), requests=Resources.from_dict(requests))
-        )
+    def _containers(key: str) -> List[Container]:
+        out = []
+        for c in spec.get(key) or []:
+            requests = (c.get("resources") or {}).get("requests") or {}
+            out.append(
+                Container(name=c.get("name", "main"), requests=Resources.from_dict(requests))
+            )
+        return out
 
     return Pod(
         meta=meta,
@@ -114,7 +116,11 @@ def pod_from_dict(d: dict) -> Pod:
         node_selector=dict(spec.get("nodeSelector") or {}),
         node_affinity=node_affinity,
         affinity_terms=affinity_terms,
-        containers=containers,
+        containers=_containers("containers"),
+        # init containers count toward pod requests — max(sum, each init)
+        # (reference overhead.go:195-209); dropping them under-counts
+        # overhead for pods with large init steps
+        init_containers=_containers("initContainers"),
         phase=status.get("phase", "Pending"),
     )
 
@@ -141,26 +147,33 @@ def pod_to_dict(pod: Pod) -> dict:
         ]
     else:
         terms = []
-    return {
-        "metadata": meta_to_dict(pod.meta),
-        "spec": {
-            "schedulerName": pod.scheduler_name,
-            "nodeName": pod.node_name,
-            "nodeSelector": dict(pod.node_selector),
-            "affinity": {
-                "nodeAffinity": {
-                    "requiredDuringSchedulingIgnoredDuringExecution": {
-                        "nodeSelectorTerms": terms
-                    }
+
+    def _containers_to_dicts(containers) -> list:
+        return [
+            {"name": c.name, "resources": {"requests": c.requests.to_dict()}}
+            for c in containers
+        ]
+
+    spec = {
+        "schedulerName": pod.scheduler_name,
+        "nodeName": pod.node_name,
+        "nodeSelector": dict(pod.node_selector),
+        "affinity": {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": terms
                 }
             }
-            if terms
-            else {},
-            "containers": [
-                {"name": c.name, "resources": {"requests": c.requests.to_dict()}}
-                for c in pod.containers
-            ],
-        },
+        }
+        if terms
+        else {},
+        "containers": _containers_to_dicts(pod.containers),
+    }
+    if pod.init_containers:
+        spec["initContainers"] = _containers_to_dicts(pod.init_containers)
+    return {
+        "metadata": meta_to_dict(pod.meta),
+        "spec": spec,
         "status": {"phase": pod.phase},
     }
 

@@ -74,3 +74,36 @@ def test_serde_roundtrip_properties():
         da2_back = serde.demand_to_dict_v1alpha2(back_d)
         assert da2_back["spec"] == da2["spec"]
         assert da2_back["status"] == da2["status"]
+
+
+def test_pod_init_containers_round_trip_and_requests():
+    """initContainers parse + serialize; pod requests = max(sum of
+    containers, each init container) per dimension (overhead.go:195-209)."""
+    from k8s_spark_scheduler_tpu.scheduler.overhead import pod_to_resources
+    from k8s_spark_scheduler_tpu.types import serde
+    from k8s_spark_scheduler_tpu.types.resources import Resources
+
+    pod_json = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [
+                {"name": "a", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}},
+                {"name": "b", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}},
+            ],
+            "initContainers": [
+                {"name": "init", "resources": {"requests": {"cpu": "4", "memory": "1Gi"}}},
+            ],
+        },
+    }
+    pod = serde.pod_from_dict(pod_json)
+    assert [c.name for c in pod.init_containers] == ["init"]
+    # cpu: init (4) > sum (2); memory: sum (2Gi) > init (1Gi)
+    assert pod_to_resources(pod).eq(Resources.of("4", "2Gi"))
+
+    again = serde.pod_from_dict(serde.pod_to_dict(pod))
+    assert [c.requests.cpu.value() for c in again.init_containers] == [4]
+    assert pod_to_resources(again).eq(Resources.of("4", "2Gi"))
+
+    # pods without init containers keep a clean wire form
+    no_init = serde.pod_to_dict(serde.pod_from_dict({"metadata": {"name": "q"}}))
+    assert "initContainers" not in no_init["spec"]
