@@ -60,11 +60,31 @@ def main(argv=None) -> int:
     # stacktrace-on-signal, as the reference registers in main.go:24-27
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
+    # Python-level handlers (run in the main thread no matter which
+    # thread receives the signal) so SIGTERM reliably takes the
+    # graceful-stop path; a SECOND signal restores the default
+    # disposition and re-raises, so a wedged shutdown can still be
+    # terminated without SIGKILL
+    import os
+    import threading
+
+    stop_event = threading.Event()
+
+    def _on_signal(signum, frame):
+        if stop_event.is_set():
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        stop_event.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+
     if args.webhook_only:
         http = ExtenderHTTPServer(None, port=args.port, webhook_only=True, host=args.host)
         http.start()
         print(f"conversion webhook serving on :{http.port}", flush=True)
-        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        stop_event.wait()
         http.stop()
         return 0
 
@@ -94,7 +114,7 @@ def main(argv=None) -> int:
     http.start()
     print(f"extender serving on :{http.port} (binpack={install.binpack_algo})", flush=True)
     try:
-        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        stop_event.wait()
     finally:
         http.stop()
         scheduler.stop()

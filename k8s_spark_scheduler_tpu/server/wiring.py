@@ -129,6 +129,10 @@ class Server:
         self._warm_thread.start()
 
     def stop(self) -> None:
+        import time as _time
+
+        deadline = _time.monotonic() + 20.0  # headroom inside the k8s
+        # default 30s termination grace period, measured from stop() entry
         warm_thread = getattr(self, "_warm_thread", None)
         if warm_thread is not None:
             self._warm_stop.set()  # signal first; join after the other stops
@@ -139,14 +143,15 @@ class Server:
         self.demand_cache.stop()
         if warm_thread is not None:
             # a healthy compile finishes in seconds; a wedged device must
-            # not hang shutdown, so give up after the timeout (the daemon
-            # flag then lets the process exit, at worst uncleanly)
-            warm_thread.join(timeout=120)
+            # not stall shutdown past the grace period, so give up at the
+            # deadline (the daemon flag then lets the process exit, at
+            # worst uncleanly)
+            warm_thread.join(timeout=max(0.0, deadline - _time.monotonic()))
             if warm_thread.is_alive():
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "solver warmup still compiling after 120s; abandoning it"
+                    "solver warmup still compiling at shutdown deadline; abandoning it"
                 )
 
 
