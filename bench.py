@@ -16,6 +16,20 @@ carry), fetch one scalar at the end, measure the relay RTT separately
 with a null program, and report per-solve latency =
 (chain_total − rtt) / CHAIN.  p99 is taken over repeated chain runs.
 
+Wedge survival: the relay's backend init can block forever, and the
+wedge outlives any single client process.  The TPU measurement therefore
+runs in a FRESH worker subprocess per attempt (``--tpu-worker``), driven
+by a bounded retry loop here — a hung worker is detached + killed and a
+new one started, because a wedge can clear between attempts (grant
+leases expire / the relay restarts).  Only after the whole retry budget
+(``BENCH_TPU_BUDGET_S``, default 600 s) is spent does the bench fall
+back to a truthful CPU number.  ``BENCH_RELAY_RESET_CMD``, when set, is
+run between attempts as an operator-supplied relay reset hook.
+
+On hardware the worker A/Bs the Pallas grid batching knob
+(``apps_per_step`` in {1, 8}; override via ``BENCH_APPS_PER_STEP`` to
+pin one) and reports the best; both numbers go to stderr diagnostics.
+
 Prints ONE JSON line:
   {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": 50/p99}
 vs_baseline > 1 means faster than the 50 ms north-star target.
@@ -26,18 +40,27 @@ from __future__ import annotations
 import functools
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-N_NODES = 10_000
-N_APPS = 1_000
+# canonical BASELINE config (5) shape; env overrides exist for smoke
+# tests only — the driver runs with the defaults
+N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
+N_APPS = int(os.environ.get("BENCH_APPS", "1000"))
 TARGET_MS = 50.0
-CHAIN = 20
-ROUNDS = 15
+CHAIN = int(os.environ.get("BENCH_CHAIN", "20"))
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", "15"))
+
+_RESULT_PREFIX = "BENCH_RESULT_JSON "
+# worker exit code for "backend came up but is not a TPU" (no point
+# retrying in that case — the platform config, not the relay, is wrong)
+_EXIT_NOT_TPU = 3
 
 
 def build_problem():
@@ -82,40 +105,10 @@ def build_problem():
     return problem, marshal_s
 
 
-def _probe_tpu_backend(timeout_s: float = 180.0) -> bool:
-    """The dev TPU sits behind a relay that can wedge; probing backend
-    init in a subprocess keeps this process unblocked.  Returns True when
-    the TPU backend is usable.  Skips the (multi-second) probe entirely
-    when no non-CPU platform is configured."""
-    from k8s_spark_scheduler_tpu.utils.tpuprobe import (
-        live_platforms,
-        probe_default_backend,
-    )
-
-    platforms = live_platforms()
-    if not platforms or platforms.split(",")[0].strip() == "cpu":
-        return False
-    backend = probe_default_backend(timeout_s)
-    return backend is not None and "tpu" in backend
-
-
-def main() -> None:
-    tpu_usable = _probe_tpu_backend()
-
-    import jax
-
-    if not tpu_usable:
-        # tpuprobe prints the "relay wedged?" hint itself when the probe hangs
-        print("# TPU backend unavailable; benching on CPU", file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
-
+def _device_args(problem):
     import jax.numpy as jnp
 
-    on_tpu = jax.default_backend() == "tpu"
-    from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue
-
-    problem, marshal_s = build_problem()
-    args = (
+    return (
         jnp.asarray(problem.avail),
         jnp.asarray(problem.driver_rank),
         jnp.asarray(problem.exec_ok),
@@ -126,26 +119,11 @@ def main() -> None:
     )
 
 
-    if on_tpu:
-        from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue
-
-        # grid batching knob for A/B on hardware (parity-validated for 1
-        # and 8; see tests/test_pallas_queue.py)
-        apps_per_step = int(os.environ.get("BENCH_APPS_PER_STEP", "1"))
-
-        def one_solve(avail, rest):
-            feas, didx, avail_after = pallas_solve_queue(
-                avail, *rest, apps_per_step=apps_per_step
-            )
-            return feas, avail_after
-    else:
-        # note: sharding the scan across virtual CPU devices was measured
-        # 18x SLOWER than single-device (per-step collective overhead);
-        # the CPU fallback stays single-device on purpose
-
-        def one_solve(avail, rest):
-            out = solve_queue(avail, *rest, evenly=False, with_placements=False)
-            return out.feasible, out.avail_after
+def _measure_chained(one_solve, args, label: str):
+    """Compile + run the chained measurement; returns (lat_ms array,
+    feasible_count, rtt_s)."""
+    import jax
+    import jax.numpy as jnp
 
     @functools.partial(jax.jit, static_argnames=("chain",))
     def chained(avail, *rest, chain=CHAIN):
@@ -167,9 +145,10 @@ def main() -> None:
         rtts.append(time.perf_counter() - t0)
     rtt_s = float(np.median(rtts))
 
-    # warmup/compile
-    total = chained(*args)
+    t0 = time.perf_counter()
+    total = chained(*args)  # warmup/compile
     feasible_count = int(total) // CHAIN
+    compile_s = time.perf_counter() - t0
 
     lat_ms = []
     for _ in range(ROUNDS):
@@ -177,8 +156,23 @@ def main() -> None:
         int(chained(*args))
         elapsed = time.perf_counter() - t0
         lat_ms.append(max(elapsed - rtt_s, 0.0) / CHAIN * 1000.0)
-
     lat = np.array(lat_ms)
+    print(
+        f"# [{label}] p99={np.percentile(lat, 99):.2f}ms "
+        f"p50={np.percentile(lat, 50):.2f}ms mean={lat.mean():.2f}ms "
+        f"max={lat.max():.2f}ms compile={compile_s:.1f}s "
+        f"rtt={rtt_s * 1000:.1f}ms feasible={feasible_count}/{N_APPS}",
+        file=sys.stderr,
+    )
+    return lat, feasible_count, rtt_s
+
+
+def _emit(
+    lat, feasible_count, rtt_s, marshal_s, backend: str, extra: str = "",
+    as_worker: bool = False,
+):
+    import jax
+
     p99 = float(np.percentile(lat, 99))
     result = {
         "metric": "p99_filter_latency_10k_nodes_x_1k_apps_batched_repack",
@@ -186,23 +180,203 @@ def main() -> None:
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / p99, 3),
     }
-    print(json.dumps(result))
+    line = json.dumps(result)
+    # the worker's stdout is parsed by the parent (prefixed line); the
+    # parent's stdout is parsed by the driver (exactly one bare JSON line)
+    print(_RESULT_PREFIX + line if as_worker else line)
     print(
         f"# p50={np.percentile(lat, 50):.2f}ms mean={lat.mean():.2f}ms "
         f"max={lat.max():.2f}ms relay_rtt={rtt_s * 1000:.1f}ms "
         f"feasible={feasible_count}/{N_APPS} marshal={marshal_s:.2f}s "
         f"platform={jax.devices()[0].platform} devices={len(jax.devices())} "
-        f"backend={'pallas' if on_tpu else 'xla-scan'} chain={CHAIN}",
+        f"backend={backend} chain={CHAIN}{extra}",
         file=sys.stderr,
     )
+
+
+def tpu_worker() -> int:
+    """One fresh-process TPU measurement attempt.  Exits nonzero (or
+    hangs, to be reaped by the parent) on any failure; on success prints
+    the result line with a machine-readable prefix."""
+    import jax
+
+    backend = jax.default_backend()  # ← the call that wedges on a bad relay
+    if "tpu" not in backend:
+        print(f"# worker: default backend is {backend!r}, not tpu", file=sys.stderr)
+        return _EXIT_NOT_TPU
+
+    from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue
+
+    problem, marshal_s = build_problem()
+    args = _device_args(problem)
+
+    pinned = os.environ.get("BENCH_APPS_PER_STEP")
+    candidates = [int(pinned)] if pinned else [1, 8]
+
+    best = None
+    for aps in candidates:
+
+        def one_solve(avail, rest, _aps=aps):
+            feas, didx, avail_after = pallas_solve_queue(
+                avail, *rest, apps_per_step=_aps
+            )
+            return feas, avail_after
+
+        lat, feasible_count, rtt_s = _measure_chained(
+            one_solve, args, label=f"pallas apps_per_step={aps}"
+        )
+        p99 = float(np.percentile(lat, 99))
+        if best is None or p99 < best[0]:
+            best = (p99, aps, lat, feasible_count, rtt_s)
+
+    _, aps, lat, feasible_count, rtt_s = best
+    _emit(
+        lat,
+        feasible_count,
+        rtt_s,
+        marshal_s,
+        backend="pallas",
+        extra=f" apps_per_step={aps}",
+        as_worker=True,
+    )
+    return 0
+
+
+def _run_tpu_worker_attempt(timeout_s: float) -> dict | None | str:
+    """Spawn a fresh worker; returns the parsed result dict, None on
+    failure/hang, or "not-tpu" when retrying is pointless.
+
+    Popen + poll loop, never a blocking wait: a wedged child sits in
+    uninterruptible device I/O where even SIGKILL may not collect it.
+    """
+    with tempfile.TemporaryFile() as outf:
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--tpu-worker"],
+            stdout=outf,
+            stderr=sys.stderr,  # stream worker diagnostics through
+            start_new_session=True,
+        )
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and child.poll() is None:
+            time.sleep(0.5)
+        code = child.poll()
+        if code is None:
+            child.kill()
+            try:
+                child.wait(timeout=1)
+            except subprocess.TimeoutExpired:
+                pass
+            print(
+                f"# TPU worker hung past {timeout_s:.0f}s (relay wedged?); killed",
+                file=sys.stderr,
+            )
+            return None
+        if code == _EXIT_NOT_TPU:
+            return "not-tpu"
+        if code != 0:
+            print(f"# TPU worker exited rc={code}", file=sys.stderr)
+            return None
+        outf.seek(0)
+        for raw in outf.read().decode(errors="replace").splitlines():
+            if raw.startswith(_RESULT_PREFIX):
+                try:
+                    return json.loads(raw[len(_RESULT_PREFIX):])
+                except json.JSONDecodeError:
+                    return None
+        print("# TPU worker exited 0 but printed no result", file=sys.stderr)
+        return None
+
+
+def try_tpu(budget_s: float, attempt_s: float) -> dict | None:
+    """Bounded retry loop around fresh-process TPU attempts."""
+    from k8s_spark_scheduler_tpu.utils.tpuprobe import live_platforms
+
+    platforms = live_platforms()
+    if not platforms or platforms.split(",")[0].strip() == "cpu":
+        print("# no accelerator platform configured; skipping TPU", file=sys.stderr)
+        return None
+
+    reset_cmd = os.environ.get("BENCH_RELAY_RESET_CMD")
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if attempt > 1 and remaining <= 30.0:
+            break
+        # every attempt (including the first) stays inside the budget
+        timeout_s = min(attempt_s, max(remaining, 10.0))
+        print(
+            f"# TPU attempt {attempt} (timeout {timeout_s:.0f}s, "
+            f"budget left {max(remaining, 0):.0f}s)",
+            file=sys.stderr,
+        )
+        result = _run_tpu_worker_attempt(timeout_s)
+        if isinstance(result, dict):
+            return result
+        if result == "not-tpu":
+            return None
+        if reset_cmd:
+            print(f"# running relay reset hook: {reset_cmd}", file=sys.stderr)
+            try:
+                subprocess.run(reset_cmd, shell=True, timeout=60)
+            except Exception as err:
+                print(f"# reset hook failed: {err}", file=sys.stderr)
+        time.sleep(min(5.0, max(deadline - time.monotonic(), 0.0)))
+    print(
+        f"# TPU retry budget ({budget_s:.0f}s) exhausted after "
+        f"{attempt} attempts; falling back to CPU",
+        file=sys.stderr,
+    )
+    return None
+
+
+def cpu_fallback() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue
+
+    problem, marshal_s = build_problem()
+    args = _device_args(problem)
+
+    # note: sharding the scan across virtual CPU devices was measured
+    # 18x SLOWER than single-device (per-step collective overhead);
+    # the CPU fallback stays single-device on purpose
+    def one_solve(avail, rest):
+        out = solve_queue(avail, *rest, evenly=False, with_placements=False)
+        return out.feasible, out.avail_after
+
+    lat, feasible_count, rtt_s = _measure_chained(one_solve, args, label="xla-scan cpu")
+    _emit(lat, feasible_count, rtt_s, marshal_s, backend="xla-scan")
+
+
+def main() -> None:
+    budget_s = float(os.environ.get("BENCH_TPU_BUDGET_S", "600"))
+    attempt_s = float(os.environ.get("BENCH_TPU_ATTEMPT_S", "240"))
+
+    result = try_tpu(budget_s, attempt_s) if budget_s > 0 else None
+    if result is not None:
+        # headline came from the TPU worker (already streamed its
+        # diagnostics); re-print the one canonical JSON line here so the
+        # driver's stdout parse sees exactly one result regardless of path
+        print(json.dumps(result))
+    else:
+        print("# TPU backend unavailable; benching on CPU", file=sys.stderr)
+        cpu_fallback()
     _secondary_configs()
 
 
 def _secondary_configs() -> None:
-    """BASELINE.json configs (1), (2), (4) measured end-to-end through the
-    extender harness (stderr diagnostics; the headline metric above is
-    config (5))."""
+    """BASELINE.json configs (1), (2), (3), (4) measured end-to-end
+    through the extender harness on CPU (stderr diagnostics; the headline
+    metric above is config (5))."""
     import logging
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
     h = None
     try:
@@ -258,6 +432,12 @@ def _secondary_configs() -> None:
             f"{len(sr.reservations)} soft reservations",
             file=sys.stderr,
         )
+        h.close()
+        h = None
+
+        # (3) heterogeneous multi-instance-group nodes with label-priority
+        # sort (exercises the label-aware fast path)
+        _config3(nodes_per_group=16)
     except Exception as err:  # diagnostics must never break the bench
         print(f"# secondary configs failed: {err}", file=sys.stderr)
     finally:
@@ -269,5 +449,50 @@ def _secondary_configs() -> None:
         logging.disable(logging.NOTSET)
 
 
+def _config3(nodes_per_group: int) -> None:
+    from k8s_spark_scheduler_tpu.ops.nodesort import LabelPriorityOrder
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+    h = Harness(
+        binpack_algo="tpu-batch",
+        is_fifo=True,
+        driver_prioritized_node_label=LabelPriorityOrder("pool", ["reserved", "spot"]),
+        executor_prioritized_node_label=LabelPriorityOrder("pool", ["spot", "reserved"]),
+    )
+    try:
+        nodes = []
+        for g, (ig, pool) in enumerate(
+            [("batch", "reserved"), ("batch", "spot"), ("ml", "reserved")]
+        ):
+            for i in range(nodes_per_group):
+                name = f"g{g}-n{i:02d}"
+                h.new_node(
+                    name,
+                    cpu="16",
+                    memory="32Gi",
+                    instance_group=ig,
+                    labels={"pool": pool},
+                )
+                nodes.append(name)
+        batch_nodes = [n for n in nodes if not n.startswith("g2-")]
+        warm = Harness.static_allocation_spark_pods("warm3", 4, instance_group="batch")
+        res = h.schedule(warm[0], batch_nodes)
+        assert res.node_names, res.failed_nodes
+        t0 = time.perf_counter()
+        pods = Harness.static_allocation_spark_pods("cfg3", 8, instance_group="batch")
+        result = h.schedule(pods[0], batch_nodes)
+        assert result.node_names, result.failed_nodes
+        cfg3_ms = (time.perf_counter() - t0) * 1000
+        print(
+            f"# config3 heterogeneous 3-group label-priority: {cfg3_ms:.1f}ms e2e "
+            f"(driver on {result.node_names[0]})",
+            file=sys.stderr,
+        )
+    finally:
+        h.close()
+
+
 if __name__ == "__main__":
+    if "--tpu-worker" in sys.argv:
+        sys.exit(tpu_worker())
     main()

@@ -10,11 +10,15 @@ derives from Quantity metadata:
   precomputed rank;
 - driver candidates = priority ∩ kube-scheduler's list; executor
   candidates = ready ∧ ¬unschedulable (nodesorting.go:41-64);
+- the per-role label-priority stable re-sort
+  (nodesorting.go:161-180): configured label values map to ascending
+  ranks, any other/missing value sorts last, ties keep the base order —
+  a stable integer argsort over precomputed rank arrays;
 - the required-node-affinity filter over snapshot label dicts
   (resource.go:292-295).
 
-Only usable when the snapshot is exact and no label-priority re-sort is
-configured; callers fall back to the Quantity path otherwise.
+Only usable when the snapshot is exact; callers fall back to the
+Quantity path otherwise.
 """
 
 from __future__ import annotations
@@ -24,13 +28,30 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..state.tensor_snapshot import TensorSnapshot
+from .nodesort import LabelPriorityOrder
 from .tensorize import INT32_SAFE, ClusterTensor
+
+
+def _label_ranks(labels_list, order: LabelPriorityOrder) -> np.ndarray:
+    """Integer sort keys replicating _label_less_than: configured values
+    get their list position, anything else (including a missing label)
+    a rank past the end so it sorts last; stability preserves the base
+    priority order within equal ranks."""
+    value_ranks = {v: i for i, v in enumerate(order.descending_priority_values)}
+    big = len(order.descending_priority_values)
+    return np.fromiter(
+        (value_ranks.get(labels.get(order.name), big) for labels in labels_list),
+        dtype=np.int64,
+        count=len(labels_list),
+    )
 
 
 def build_cluster_tensor(
     snap: TensorSnapshot,
     driver_pod,
     candidate_names: List[str],
+    driver_label_priority: Optional[LabelPriorityOrder] = None,
+    executor_label_priority: Optional[LabelPriorityOrder] = None,
 ) -> Optional[Tuple[ClusterTensor, Dict[str, str]]]:
     """(cluster tensor, node→zone map) or None when the fast path can't
     represent the snapshot exactly."""
@@ -101,16 +122,38 @@ def build_cluster_tensor(
     name_rank = np.argsort(np.argsort(np.array(names, dtype=object)))
     order = np.lexsort((name_rank, avail[:, 0], avail[:, 1], zone_priority[zone_id]))
 
-    # reorder everything into executor priority order, then assign driver
-    # ranks by cumulative candidate count — all vectorized
+    # per-role label-priority re-sort on top of the base order
+    # (nodesorting.go:161-180).  The array order is the EXECUTOR priority
+    # order (the solver packs executors in array order); the driver order
+    # lives in driver_rank, so the two roles can be re-sorted
+    # independently, exactly like the slow path's two stable sorts.
+    need_labels = driver_label_priority is not None or executor_label_priority is not None
+    labels_sel = [snap.labels[i] for i in idx] if need_labels else None
     perm = order
+    if executor_label_priority is not None:
+        exec_keys = _label_ranks(labels_sel, executor_label_priority)
+        perm = perm[np.argsort(exec_keys[perm], kind="stable")]
+
     names_arr = np.array(names, dtype=object)[perm]
     candidate_set = set(candidate_names)
-    cand_mask = np.fromiter(
-        (name in candidate_set for name in names_arr), dtype=bool, count=len(names_arr)
+    # driver order = BASE order ∩ candidates (never the executor-resorted
+    # order), stable-sorted by the driver label rank when configured;
+    # ranks are then scattered into final array positions
+    cand_in_base = np.fromiter(
+        (names[i] in candidate_set for i in order), dtype=bool, count=len(order)
     )
+    cand_base_positions = order[np.flatnonzero(cand_in_base)]
+    if driver_label_priority is not None:
+        d_keys = _label_ranks(labels_sel, driver_label_priority)
+        cand_base_positions = cand_base_positions[
+            np.argsort(d_keys[cand_base_positions], kind="stable")
+        ]
+    pos_in_array = np.empty(len(perm), dtype=np.int64)
+    pos_in_array[perm] = np.arange(len(perm))
     driver_rank = np.full(len(names_arr), INT32_SAFE, dtype=np.int64)
-    driver_rank[cand_mask] = np.arange(int(cand_mask.sum()))
+    driver_rank[pos_in_array[cand_base_positions]] = np.arange(
+        len(cand_base_positions)
+    )
     exec_ok = ready[perm] & ~unsched[perm]
     ordered_names = list(names_arr)
 

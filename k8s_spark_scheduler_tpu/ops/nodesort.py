@@ -97,6 +97,10 @@ class NodeSorter:
         driver_prioritized_node_label: Optional[LabelPriorityOrder] = None,
         executor_prioritized_node_label: Optional[LabelPriorityOrder] = None,
     ):
+        # public capability surface: consumers (the tensor fast path)
+        # read these instead of the comparator internals
+        self.driver_label_priority = driver_prioritized_node_label
+        self.executor_label_priority = executor_prioritized_node_label
         self._driver_less_than = (
             _label_less_than(driver_prioritized_node_label)
             if driver_prioritized_node_label

@@ -111,20 +111,16 @@ class SparkSchedulerExtender:
         self._metrics = metrics or default_registry
         self._event_log = event_log
         self._waste_reporter = waste_reporter
-        # event-driven integer snapshot; usable for the driver fast path
-        # only when no label-priority re-sort is configured (the fast
-        # lexsort replicates the default NodeSorter ordering)
+        # event-driven integer snapshot for the driver fast path; the
+        # fast lexsort replicates the NodeSorter ordering including any
+        # configured per-role label-priority re-sort
         self._tensor_snapshot = tensor_snapshot_cache
         # kube-scheduler serializes Filter calls per scheduler instance
         # (SURVEY §2.10); the reference's state (lastRequest, the
         # reconcile-then-pack flow) relies on that — enforce it here so a
         # threaded HTTP front end can't interleave predicates
         self._predicate_lock = threading.Lock()
-        self._fast_path_ok = (
-            tensor_snapshot_cache is not None
-            and node_sorter._driver_less_than is None
-            and node_sorter._executor_less_than is None
-        )
+        self._fast_path_ok = tensor_snapshot_cache is not None
         self._last_request = 0.0
 
     # -- entry point ---------------------------------------------------------
@@ -427,7 +423,13 @@ class SparkSchedulerExtender:
             from ..ops.sparkapp import AppDemand
 
             snap = self._tensor_snapshot.snapshot()
-            built = build_cluster_tensor(snap, driver, list(node_names))
+            built = build_cluster_tensor(
+                snap,
+                driver,
+                list(node_names),
+                driver_label_priority=self._node_sorter.driver_label_priority,
+                executor_label_priority=self._node_sorter.executor_label_priority,
+            )
             if built is None:
                 return None
             cluster, zones = built

@@ -38,6 +38,8 @@ class Harness:
         dynamic_allocation_single_az: bool = False,
         with_demand_crd: bool = True,
         extra_install: Optional[Install] = None,
+        driver_prioritized_node_label=None,
+        executor_prioritized_node_label=None,
     ):
         self.api = APIServer()
         if with_demand_crd:
@@ -48,6 +50,8 @@ class Harness:
             binpack_algo=binpack_algo,
             instance_group_label=instance_group_label,
             should_schedule_dynamically_allocated_executors_in_same_az=dynamic_allocation_single_az,
+            driver_prioritized_node_label=driver_prioritized_node_label,
+            executor_prioritized_node_label=executor_prioritized_node_label,
         )
         self.server: Server = init_server_with_clients(
             self.api, install, start_background=True, demand_poll_interval=0.02
@@ -76,6 +80,7 @@ class Harness:
         instance_group_label: str = "resource_channel",
         unschedulable: bool = False,
         ready: bool = True,
+        labels: Optional[dict] = None,
     ) -> Node:
         """extender_test_utils.go:239-271."""
         node = Node(
@@ -84,6 +89,7 @@ class Harness:
                 labels={
                     ZONE_LABEL: zone,
                     instance_group_label: instance_group,
+                    **(labels or {}),
                 },
             ),
             allocatable=Resources.of(cpu, memory, gpu),

@@ -159,6 +159,160 @@ def test_fast_path_decisions_match_slow_path_under_churn():
     assert results["tightly-pack"] == results["tpu-batch"]
 
 
+def _label_priority_cases():
+    from k8s_spark_scheduler_tpu.ops.nodesort import LabelPriorityOrder
+
+    dlp = LabelPriorityOrder("pool", ["reserved", "spot"])
+    elp = LabelPriorityOrder("pool", ["spot", "reserved"])
+    # asymmetric configs matter: an executor-only re-sort must NOT
+    # perturb the driver rank order (and vice versa)
+    return [(dlp, elp), (dlp, None), (None, elp)]
+
+
+@pytest.mark.parametrize("dlp,elp", _label_priority_cases())
+def test_fast_path_label_priority_order_matches_nodesorter(dlp, elp):
+    """build_cluster_tensor's per-role label re-sort must replicate the
+    NodeSorter's stable comparator sort exactly (nodesorting.go:161-180):
+    same executor array order, same driver rank order, including nodes
+    with unlisted or missing label values."""
+    from k8s_spark_scheduler_tpu.ops.fast_path import build_cluster_tensor
+    from k8s_spark_scheduler_tpu.ops.nodesort import NodeSorter
+    from k8s_spark_scheduler_tpu.ops.tensorize import INT32_SAFE
+
+    h = Harness(
+        binpack_algo="tpu-batch",
+        driver_prioritized_node_label=dlp,
+        executor_prioritized_node_label=elp,
+    )
+    try:
+        rng = random.Random(5)
+        pools = ["reserved", "spot", "other", None]
+        names = []
+        for i in range(12):
+            pool = pools[i % 4]
+            h.new_node(
+                f"n{i:02d}",
+                cpu=str(rng.randint(2, 16)),
+                memory=f"{rng.randint(2, 32)}Gi",
+                zone=f"z{i % 3}",
+                labels={"pool": pool} if pool else {},
+            )
+            names.append(f"n{i:02d}")
+        candidates = names[:9]
+        driver = h.static_allocation_spark_pods("app-lp", 2)[0]
+
+        snap = h.server.tensor_snapshot.snapshot()
+        built = build_cluster_tensor(
+            snap, driver, candidates,
+            driver_label_priority=dlp, executor_label_priority=elp,
+        )
+        assert built is not None
+        cluster, _zones = built
+
+        nodes = h.server.node_informer.list()
+        usage = h.server.resource_reservation_manager.get_reserved_resources()
+        overhead = h.server.overhead_computer.get_overhead(nodes)
+        metadata = node_scheduling_metadata_for_nodes(nodes, usage, overhead)
+        sorter = NodeSorter(dlp, elp)
+        expect_driver, expect_executor = sorter.potential_nodes(metadata, candidates)
+
+        got_executor = [
+            n for n, ok in zip(cluster.node_names, cluster.exec_ok) if ok
+        ]
+        assert got_executor == expect_executor
+
+        ranked = [
+            (rank, n)
+            for n, rank in zip(cluster.node_names, cluster.driver_rank)
+            if rank < INT32_SAFE
+        ]
+        got_driver = [n for _, n in sorted(ranked)]
+        assert got_driver == expect_driver
+    finally:
+        h.close()
+
+
+def test_fast_path_decisions_match_slow_path_with_label_priority():
+    """End-to-end: with per-role label priorities configured the fast
+    path must stay engaged and produce the slow path's exact decisions."""
+    from k8s_spark_scheduler_tpu.ops.nodesort import LabelPriorityOrder
+
+    dlp = LabelPriorityOrder("pool", ["reserved", "spot"])
+    elp = LabelPriorityOrder("pool", ["spot", "reserved"])
+    results = {}
+    for algo in ("tightly-pack", "tpu-batch"):
+        h = Harness(
+            binpack_algo=algo,
+            is_fifo=True,
+            driver_prioritized_node_label=dlp,
+            executor_prioritized_node_label=elp,
+        )
+        try:
+            rng = random.Random(31337)
+            pools = ["reserved", "spot", "other"]
+            for i in range(6):
+                h.new_node(
+                    f"n{i}",
+                    cpu="8",
+                    memory="8Gi",
+                    zone=f"z{i % 2}",
+                    labels={"pool": pools[i % 3]},
+                )
+            nodes = [f"n{i}" for i in range(6)]
+            log = []
+            live = []
+            for step in range(30):
+                if rng.random() < 0.6 or not live:
+                    pods = h.static_allocation_spark_pods(
+                        f"app-{step}", rng.randint(1, 4)
+                    )
+                    r = h.schedule(pods[0], nodes)
+                    log.append((f"d{step}", tuple(r.node_names or [])))
+                    if r.node_names:
+                        placed = [pods[0]]
+                        for p in pods[1:]:
+                            er = h.schedule(p, nodes)
+                            log.append((p.name, tuple(er.node_names or [])))
+                            if er.node_names:
+                                placed.append(p)
+                        live.append(placed)
+                else:
+                    placed = live.pop(rng.randrange(len(live)))
+                    for p in placed:
+                        try:
+                            h.delete_pod(p)
+                        except Exception:
+                            pass
+                    h.wait_quiesced()
+                    log.append(("teardown", len(placed)))
+            if algo == "tpu-batch":
+                # the fast lane must have engaged at least once
+                calls = []
+                original = h.extender._try_fast_driver_path
+
+                def spy(*args, **kwargs):
+                    out = original(*args, **kwargs)
+                    calls.append(out is not None)
+                    return out
+
+                h.extender._try_fast_driver_path = spy
+                probe = h.static_allocation_spark_pods("app-probe", 1)[0]
+                h.schedule(probe, nodes)
+                assert calls and calls[-1], (
+                    "fast path fell back with label priority configured"
+                )
+                log.append(("probe", None))
+            else:
+                h.schedule(
+                    h.static_allocation_spark_pods("app-probe", 1)[0], nodes
+                )
+                log.append(("probe", None))
+            results[algo] = log
+        finally:
+            h.close()
+    assert results["tightly-pack"] == results["tpu-batch"]
+
+
 def test_fast_path_used_for_tpu_batch():
     """The fast path must actually engage (not silently fall back)."""
     h = Harness(binpack_algo="tpu-batch", is_fifo=True)
