@@ -244,28 +244,19 @@ def tpu_worker() -> int:
 
 def _run_tpu_worker_attempt(timeout_s: float) -> dict | None | str:
     """Spawn a fresh worker; returns the parsed result dict, None on
-    failure/hang, or "not-tpu" when retrying is pointless.
+    failure/hang, or "not-tpu" when retrying is pointless.  Hang safety
+    (detached Popen + poll loop, kill without a blocking wait) lives in
+    tpuprobe.run_detached."""
+    from k8s_spark_scheduler_tpu.utils.tpuprobe import run_detached
 
-    Popen + poll loop, never a blocking wait: a wedged child sits in
-    uninterruptible device I/O where even SIGKILL may not collect it.
-    """
     with tempfile.TemporaryFile() as outf:
-        child = subprocess.Popen(
+        code = run_detached(
             [sys.executable, os.path.abspath(__file__), "--tpu-worker"],
-            stdout=outf,
-            stderr=sys.stderr,  # stream worker diagnostics through
-            start_new_session=True,
+            timeout_s,
+            outf,
+            sys.stderr,  # stream worker diagnostics through
         )
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline and child.poll() is None:
-            time.sleep(0.5)
-        code = child.poll()
         if code is None:
-            child.kill()
-            try:
-                child.wait(timeout=1)
-            except subprocess.TimeoutExpired:
-                pass
             print(
                 f"# TPU worker hung past {timeout_s:.0f}s (relay wedged?); killed",
                 file=sys.stderr,
@@ -300,10 +291,10 @@ def try_tpu(budget_s: float, attempt_s: float) -> dict | None:
     deadline = time.monotonic() + budget_s
     attempt = 0
     while True:
-        attempt += 1
         remaining = deadline - time.monotonic()
-        if attempt > 1 and remaining <= 30.0:
+        if attempt > 0 and remaining <= 30.0:
             break
+        attempt += 1
         # every attempt (including the first) stays inside the budget
         timeout_s = min(attempt_s, max(remaining, 10.0))
         print(

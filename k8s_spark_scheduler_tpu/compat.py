@@ -1,0 +1,47 @@
+"""Reference-quirk compatibility policy (documentation home).
+
+The reference has a handful of accidental-looking behaviors that are
+nevertheless load-bearing for decision parity.  We replicate them
+deliberately (each site carries a ``# QUIRK`` comment); the install key
+``strict-reference-parity`` (**default on**) names the policy and lets
+operators opt out of the ones that are safe to correct per-deployment.
+The flag is plain configuration — threaded from ``config.Install``
+through ``server/wiring.py`` into the consuming instances (no process
+globals), so two servers in one process can run different modes:
+
+1. **Double overhead-add on the executor reschedule path**
+   (reference ``resource.go:638-643``): nodes carrying reservations see
+   ``allocatable − reserved − 2×overhead``.  Off → overhead is counted
+   once, like the driver path.  Consumer:
+   ``scheduler/extender.py`` (``strict_reference_parity`` ctor arg).
+2. **Minimal-fragmentation efficiency omission**
+   (reference ``minimal_fragmentation.go:59-94``): executor placements
+   are not folded into the reserved map, so reported packing
+   efficiencies reflect only the driver.  Off → executor reservations
+   are folded in and efficiencies are complete (this also changes which
+   AZ ``single-az-minimal-fragmentation`` picks, since the AZ choice
+   ranks by avg efficiency).  Consumers:
+   ``ops/packers.make_minimal_fragmentation`` and
+   ``ops/batch_adapter.TpuBatchBinpacker``, both built by
+   ``ops/registry.select_binpacker(name, strict_reference_parity=...)``.
+
+Quirks that are NOT switchable (kept identically in both modes) are the
+ones that define the admission semantics shared by the host oracles and
+the device kernels — correcting them would change which gangs are
+admitted and break the zero-feasibility-regression gate rather than
+merely report different numbers:
+
+- FIFO post-placement usage subtraction assigns (not accumulates)
+  per-node entries (``sparkpods.go:139-146``; ``scheduler/sparkpods.py``
+  + ``ops/batch_solver.usage_delta``).
+- ``_choose_best_result`` requires a strict efficiency improvement, so
+  an all-zero-efficiency AZ set is reported infeasible
+  (``single_az.go:75-97``).
+- Failover's greedy node fill does not refund the failed probe
+  (``failover.go:424-427``).
+
+See ``docs/design.md`` § "Reference-parity compatibility mode" for the
+full behavior table.
+"""
+
+DEFAULT_STRICT = True

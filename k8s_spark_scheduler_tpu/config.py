@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from . import compat
 from .ops.nodesort import LabelPriorityOrder
 from .scheduler.labels import DEFAULT_INSTANCE_GROUP_LABEL
 
@@ -46,6 +47,9 @@ class Install:
     driver_prioritized_node_label: Optional[LabelPriorityOrder] = None
     executor_prioritized_node_label: Optional[LabelPriorityOrder] = None
     resource_reservation_crd_annotations: Dict[str, str] = field(default_factory=dict)
+    # replicate the reference's accidental-but-load-bearing behaviors
+    # (see compat.py for the list); off = corrected semantics
+    strict_reference_parity: bool = compat.DEFAULT_STRICT
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -92,5 +96,8 @@ class Install:
             ),
             resource_reservation_crd_annotations=d.get(
                 "resource-reservation-crd-annotations", {}
+            ),
+            strict_reference_parity=d.get(
+                "strict-reference-parity", compat.DEFAULT_STRICT
             ),
         )

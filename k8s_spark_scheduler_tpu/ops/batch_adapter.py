@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import compat
 from ..types.resources import NodeGroupSchedulingMetadata, Resources
 from . import packers
 from .efficiency import compute_packing_efficiencies
@@ -133,9 +134,15 @@ class TpuBatchBinpacker:
     policy-invariant, see batch_solver docstring).
     """
 
-    def __init__(self, assignment_policy: str = "tightly-pack", verify_against_oracle: bool = False):
+    def __init__(
+        self,
+        assignment_policy: str = "tightly-pack",
+        verify_against_oracle: bool = False,
+        strict_reference_parity: bool = compat.DEFAULT_STRICT,
+    ):
         self.assignment_policy = assignment_policy
         self.verify_against_oracle = verify_against_oracle
+        self.strict_reference_parity = strict_reference_parity
 
     def __call__(
         self,
@@ -157,7 +164,9 @@ class TpuBatchBinpacker:
         problem = scale_problem(cluster, apps)
         oracle = {
             "tightly-pack": packers.tightly_pack,
-            "minimal-fragmentation": packers.minimal_fragmentation_pack,
+            "minimal-fragmentation": packers.make_minimal_fragmentation_pack(
+                self.strict_reference_parity
+            ),
         }.get(self.assignment_policy, packers.distribute_evenly)
         if not problem.ok:
             logger.warning("snapshot not exactly tensorizable; using host oracle")
@@ -247,8 +256,14 @@ class TpuBatchBinpacker:
                 return empty_packing_result()
             # the reference's min-frag does NOT fold executor placements
             # into reserved for efficiency (packers.minimal_fragmentation
-            # QUIRK) — efficiency accounting sees only the driver
+            # QUIRK, switchable) — under strict parity efficiency
+            # accounting sees only the driver; corrected mode folds the
+            # placements in, mirroring the oracle's write-back
             counts = np.zeros(len(names), dtype=np.int64)
+            if not self.strict_reference_parity:
+                pos = {name: i for i, name in enumerate(names)}
+                for node in executor_nodes:
+                    counts[pos[node]] += 1
         else:
             cap = np.asarray(solve.exec_capacity)[: len(names)]
             counts = evenly_counts(cap, executor_count)
@@ -300,10 +315,15 @@ def tpu_batch_binpacker() -> Binpacker:
     )
 
 
-def tpu_batch_min_frag_binpacker() -> Binpacker:
+def tpu_batch_min_frag_binpacker(
+    strict_reference_parity: bool = compat.DEFAULT_STRICT,
+) -> Binpacker:
     return Binpacker(
         name="tpu-batch-minimal-fragmentation",
-        binpack_func=TpuBatchBinpacker(assignment_policy="minimal-fragmentation"),
+        binpack_func=TpuBatchBinpacker(
+            assignment_policy="minimal-fragmentation",
+            strict_reference_parity=strict_reference_parity,
+        ),
         is_single_az=False,
     )
 

@@ -18,6 +18,7 @@ import bisect
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from .. import compat
 from ..types.resources import (
     NodeGroupResources,
     NodeGroupSchedulingMetadata,
@@ -146,28 +147,48 @@ def distribute_executors_evenly(
     return None, False
 
 
-def minimal_fragmentation(
-    executor_resources: Resources,
-    executor_count: int,
-    node_priority_order: Sequence[str],
-    metadata: NodeGroupSchedulingMetadata,
-    reserved_resources: NodeGroupResources,
-) -> Tuple[Optional[List[str]], bool]:
+def make_minimal_fragmentation(
+    strict_reference_parity: bool = compat.DEFAULT_STRICT,
+) -> GenericBinPackFunction:
     """Prefer fewest hosts, avoiding mostly-empty nodes unless needed
     (minimal_fragmentation.go:59-94).
 
-    QUIRK: unlike the other distribution functions this never writes back
-    into reserved_resources, so packing efficiencies reported upstream
-    reflect only the driver reservation (reference behavior).
+    QUIRK (switchable, install key ``strict-reference-parity``): unlike
+    the other distribution functions the reference never writes back into
+    reserved_resources, so packing efficiencies reported upstream reflect
+    only the driver reservation.  With strict parity off the placements
+    are folded in and efficiencies are complete.
     """
-    if executor_count == 0:
-        return [], True
 
-    capacities = cap.get_node_capacities(
-        node_priority_order, metadata, reserved_resources, executor_resources
-    )
-    capacities = cap.filter_out_nodes_without_capacity(capacities)
-    return minimal_fragmentation_from_capacities(executor_count, capacities)
+    def minimal_fragmentation(
+        executor_resources: Resources,
+        executor_count: int,
+        node_priority_order: Sequence[str],
+        metadata: NodeGroupSchedulingMetadata,
+        reserved_resources: NodeGroupResources,
+    ) -> Tuple[Optional[List[str]], bool]:
+        if executor_count == 0:
+            return [], True
+
+        capacities = cap.get_node_capacities(
+            node_priority_order, metadata, reserved_resources, executor_resources
+        )
+        capacities = cap.filter_out_nodes_without_capacity(capacities)
+        executor_nodes, ok = minimal_fragmentation_from_capacities(
+            executor_count, capacities
+        )
+        if ok and executor_nodes and not strict_reference_parity:
+            for n in executor_nodes:
+                reserved_resources[n] = reserved_resources.get(
+                    n, Resources.zero()
+                ).add(executor_resources)
+        return executor_nodes, ok
+
+    return minimal_fragmentation
+
+
+# strict default instance (the reference's exact behavior)
+minimal_fragmentation = make_minimal_fragmentation()
 
 
 def minimal_fragmentation_from_capacities(
@@ -360,27 +381,43 @@ def distribute_evenly(
     )
 
 
-def minimal_fragmentation_pack(
-    driver_resources: Resources,
-    executor_resources: Resources,
-    executor_count: int,
-    driver_node_priority_order: Sequence[str],
-    executor_node_priority_order: Sequence[str],
-    metadata: NodeGroupSchedulingMetadata,
-) -> PackingResult:
-    return spark_bin_pack(
-        driver_resources,
-        executor_resources,
-        executor_count,
-        driver_node_priority_order,
-        executor_node_priority_order,
-        metadata,
-        minimal_fragmentation,
+def make_minimal_fragmentation_pack(
+    strict_reference_parity: bool = compat.DEFAULT_STRICT,
+) -> SparkBinPackFunction:
+    fn = make_minimal_fragmentation(strict_reference_parity)
+
+    def minimal_fragmentation_pack(
+        driver_resources: Resources,
+        executor_resources: Resources,
+        executor_count: int,
+        driver_node_priority_order: Sequence[str],
+        executor_node_priority_order: Sequence[str],
+        metadata: NodeGroupSchedulingMetadata,
+    ) -> PackingResult:
+        return spark_bin_pack(
+            driver_resources,
+            executor_resources,
+            executor_count,
+            driver_node_priority_order,
+            executor_node_priority_order,
+            metadata,
+            fn,
+        )
+
+    return minimal_fragmentation_pack
+
+
+def make_single_az_minimal_fragmentation(
+    strict_reference_parity: bool = compat.DEFAULT_STRICT,
+) -> SparkBinPackFunction:
+    return _single_az_spark_bin_function(
+        make_minimal_fragmentation(strict_reference_parity)
     )
 
 
+minimal_fragmentation_pack = make_minimal_fragmentation_pack()
 single_az_tightly_pack = _single_az_spark_bin_function(tightly_pack_executors)
-single_az_minimal_fragmentation = _single_az_spark_bin_function(minimal_fragmentation)
+single_az_minimal_fragmentation = make_single_az_minimal_fragmentation()
 
 
 def az_aware_tightly_pack(

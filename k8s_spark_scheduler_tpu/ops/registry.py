@@ -10,6 +10,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass
 
+from .. import compat
 from . import packers
 from .packers import SparkBinPackFunction
 
@@ -52,8 +53,28 @@ register(SINGLE_AZ_MINIMAL_FRAGMENTATION, packers.single_az_minimal_fragmentatio
 register(MINIMAL_FRAGMENTATION, packers.minimal_fragmentation_pack, False)
 
 
-def select_binpacker(name: str) -> Binpacker:
-    """binpack.go:52-58; unknown → distribute-evenly."""
+def _minfrag_binpacker(name: str, strict: bool) -> Binpacker:
+    """The two host min-frag policies, built for either compat mode —
+    the only policies with a switchable quirk (efficiency write-back)."""
+    if name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
+        return Binpacker(
+            name, packers.make_single_az_minimal_fragmentation(strict), True
+        )
+    return Binpacker(name, packers.make_minimal_fragmentation_pack(strict), False)
+
+
+def select_binpacker(
+    name: str, strict_reference_parity: bool = compat.DEFAULT_STRICT
+) -> Binpacker:
+    """binpack.go:52-58; unknown → distribute-evenly.
+
+    strict_reference_parity threads the compat policy (compat.py) into
+    the minimal-fragmentation variants."""
+    if not strict_reference_parity and name in (
+        MINIMAL_FRAGMENTATION,
+        SINGLE_AZ_MINIMAL_FRAGMENTATION,
+    ):
+        return _minfrag_binpacker(name, strict_reference_parity)
     if name in (TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE, TPU_BATCH_MIN_FRAG):
         try:
             # imported lazily: pulls in jax
@@ -65,7 +86,7 @@ def select_binpacker(name: str) -> Binpacker:
             )
 
             if name == TPU_BATCH_MIN_FRAG:
-                return tpu_batch_min_frag_binpacker()
+                return tpu_batch_min_frag_binpacker(strict_reference_parity)
             if name == TPU_BATCH_SINGLE_AZ:
                 return tpu_batch_single_az_binpacker()
             if name == TPU_BATCH_AZ_AWARE:
@@ -87,6 +108,8 @@ def select_binpacker(name: str) -> Binpacker:
                 fallback,
                 exc_info=True,
             )
+            if fallback == MINIMAL_FRAGMENTATION and not strict_reference_parity:
+                return _minfrag_binpacker(fallback, strict_reference_parity)
             return _REGISTRY[fallback]
     return _REGISTRY.get(name, _REGISTRY[DEFAULT])
 

@@ -16,6 +16,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from .. import compat
 from ..config import FifoConfig
 from ..demands.manager import DemandManager
 from ..events import events as ev
@@ -94,6 +95,7 @@ class SparkSchedulerExtender:
         event_log: Optional[ev.EventLog] = None,
         waste_reporter=None,
         tensor_snapshot_cache=None,
+        strict_reference_parity: bool = compat.DEFAULT_STRICT,
     ):
         self._node_informer = node_informer
         self._pod_lister = pod_lister
@@ -121,6 +123,7 @@ class SparkSchedulerExtender:
         # threaded HTTP front end can't interleave predicates
         self._predicate_lock = threading.Lock()
         self._fast_path_ok = tensor_snapshot_cache is not None
+        self._strict_reference_parity = strict_reference_parity
         self._last_request = 0.0
 
     # -- entry point ---------------------------------------------------------
@@ -684,17 +687,22 @@ class SparkSchedulerExtender:
         overhead = self._overhead.get_overhead(available_nodes)
         metadata = node_scheduling_metadata_for_nodes(available_nodes, usage, overhead)
 
-        # QUIRK (reference resource.go:638-643 + resources.go:61-100): the
-        # Go NodeSchedulingMetadataForNodes mutates the caller's usage map
+        # QUIRK (switchable, install key strict-reference-parity;
+        # reference resource.go:638-643 + resources.go:61-100): the Go
+        # NodeSchedulingMetadataForNodes mutates the caller's usage map
         # in place (usage[node].Add(overhead) through a shared pointer) for
         # nodes that have a usage entry, and the subsequent usage.Add(
         # overhead) adds it AGAIN — so the first-fit reschedule path sees
         # allocatable − reserved − 2×overhead on nodes with reservations,
         # and allocatable − overhead on nodes without.  Replicated exactly
-        # for decision parity.
+        # for decision parity; with strict parity off overhead counts once
+        # on every node (the driver path's semantics).
+        double_overhead = self._strict_reference_parity
         for node_name, node_overhead in overhead.items():
             if node_name in usage:
-                usage[node_name] = usage[node_name].add(node_overhead).add(node_overhead)
+                usage[node_name] = usage[node_name].add(node_overhead)
+                if double_overhead:
+                    usage[node_name] = usage[node_name].add(node_overhead)
             else:
                 usage[node_name] = node_overhead
         available_resources = available_for_nodes(available_nodes, usage)

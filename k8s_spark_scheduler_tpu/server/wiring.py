@@ -187,7 +187,9 @@ def init_server_with_clients(
         api, rr_informer, install.async_client.max_retry_count, rate_bucket=rate_bucket
     )
     lazy_demand_informer = LazyDemandInformer(api, factory, poll_interval=demand_poll_interval)
-    binpacker = select_binpacker(install.binpack_algo)
+    binpacker = select_binpacker(
+        install.binpack_algo, strict_reference_parity=install.strict_reference_parity
+    )
     demand_cache = SafeDemandCache(
         lazy_demand_informer,
         api,
@@ -236,6 +238,7 @@ def init_server_with_clients(
         event_log=event_log,
         waste_reporter=waste_reporter,
         tensor_snapshot_cache=tensor_snapshot,
+        strict_reference_parity=install.strict_reference_parity,
     )
     marker = UnschedulablePodMarker(
         api,

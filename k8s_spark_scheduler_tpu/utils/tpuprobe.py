@@ -18,31 +18,41 @@ from typing import Optional
 _PROBE_SRC = "import jax; print(jax.default_backend())"
 
 
+def run_detached(argv, timeout_s: float, stdout, stderr) -> Optional[int]:
+    """Run ``argv`` detached with a poll-loop timeout; returns the exit
+    code, or None when it was still running at the deadline (killed, and
+    reaped only if the kill lands).
+
+    Popen + a poll loop — never a blocking wait — because a wedged child
+    can sit in uninterruptible device I/O where ``communicate()`` after
+    kill() blocks forever too.  ``start_new_session`` keeps terminal
+    signals away from the child.
+    """
+    child = subprocess.Popen(
+        argv, stdout=stdout, stderr=stderr, start_new_session=True
+    )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and child.poll() is None:
+        time.sleep(0.5)
+    code = child.poll()
+    if code is None:
+        child.kill()
+        try:  # reap if the kill lands; wait(timeout) polls, never blocks
+            child.wait(timeout=1)
+        except subprocess.TimeoutExpired:
+            pass
+        return None
+    return code
+
+
 def probe_default_backend(timeout_s: float = 120.0) -> Optional[str]:
     """Return the default jax backend name ("tpu", "cpu", ...), or None
-    when backend init hangs past ``timeout_s`` or exits nonzero.
-
-    Uses Popen + a poll loop — never a blocking wait — because a wedged
-    child can sit in uninterruptible device I/O where ``communicate()``
-    after kill() blocks forever too.
-    """
+    when backend init hangs past ``timeout_s`` or exits nonzero."""
     with tempfile.TemporaryFile() as outf, tempfile.TemporaryFile() as errf:
-        child = subprocess.Popen(
-            [sys.executable, "-c", _PROBE_SRC],
-            stdout=outf,
-            stderr=errf,
-            start_new_session=True,  # keep terminal signals away from it
+        code = run_detached(
+            [sys.executable, "-c", _PROBE_SRC], timeout_s, outf, errf
         )
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline and child.poll() is None:
-            time.sleep(0.5)
-        code = child.poll()
         if code is None:
-            child.kill()
-            try:  # reap if the kill lands; wait(timeout) polls, never blocks
-                child.wait(timeout=1)
-            except subprocess.TimeoutExpired:
-                pass
             print(
                 f"backend probe hung past {timeout_s:.0f}s (relay wedged?)",
                 file=sys.stderr,

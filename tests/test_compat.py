@@ -1,0 +1,134 @@
+"""The strict_reference_parity compatibility mode (compat.py): default
+on replicates the reference's accidental-but-load-bearing behaviors;
+off corrects the switchable ones.  Both modes must keep device/oracle
+parity with themselves."""
+
+import pytest
+
+from k8s_spark_scheduler_tpu.config import Install
+from k8s_spark_scheduler_tpu.ops import packers
+from k8s_spark_scheduler_tpu.ops.batch_adapter import TpuBatchBinpacker
+from k8s_spark_scheduler_tpu.ops.nodesort import NodeSorter
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types.objects import Container, ObjectMeta, Pod, PodPhase
+from k8s_spark_scheduler_tpu.types.resources import (
+    NodeSchedulingMetadata,
+    Resources,
+)
+
+
+def test_install_parses_strict_flag():
+    assert Install.from_dict({}).strict_reference_parity is True
+    assert (
+        Install.from_dict({"strict-reference-parity": False}).strict_reference_parity
+        is False
+    )
+
+
+def _minfrag_cluster():
+    # small nodes force the 6×2-CPU gang to spread off the driver node
+    metadata = {
+        f"n{i}": NodeSchedulingMetadata(
+            available=Resources.of("8", "8Gi"),
+            schedulable=Resources.of("8", "8Gi"),
+            zone_label="z1",
+        )
+        for i in range(3)
+    }
+    order = list(metadata)
+    return metadata, order
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_minfrag_efficiency_quirk_switch(strict):
+    """Strict: efficiencies reflect only the driver (the reference's
+    missing write-back).  Corrected: executor placements are folded in —
+    and the device decode matches the oracle in BOTH modes."""
+    metadata, order = _minfrag_cluster()
+    args = (Resources.of("1", "1Gi"), Resources.of("2", "1Gi"), 6, order, order, metadata)
+
+    oracle = packers.make_minimal_fragmentation_pack(strict)(*args)
+    device = TpuBatchBinpacker(
+        "minimal-fragmentation", strict_reference_parity=strict
+    )(*args)
+
+    assert oracle.has_capacity and device.has_capacity
+    assert oracle.driver_node == device.driver_node
+    assert oracle.executor_nodes == device.executor_nodes
+
+    exec_nodes = set(oracle.executor_nodes) - {oracle.driver_node}
+    assert exec_nodes, "scenario must place executors off the driver node"
+    for n in exec_nodes:
+        if strict:
+            # reference quirk: executor placements invisible to efficiency
+            assert oracle.packing_efficiencies[n].cpu == 0.0
+            assert device.packing_efficiencies[n].cpu == 0.0
+        else:
+            assert oracle.packing_efficiencies[n].cpu > 0.0
+            assert device.packing_efficiencies[n].cpu > 0.0
+    # device efficiencies must equal the oracle's exactly in both modes
+    assert set(device.packing_efficiencies) == set(oracle.packing_efficiencies)
+    for n, eff in oracle.packing_efficiencies.items():
+        got = device.packing_efficiencies[n]
+        assert (got.cpu, got.memory, got.gpu) == (eff.cpu, eff.memory, eff.gpu)
+
+
+def test_registry_threads_strict_flag():
+    """select_binpacker must hand the compat policy to the min-frag
+    variants (the wiring path every server boot takes)."""
+    from k8s_spark_scheduler_tpu.ops.registry import select_binpacker
+
+    metadata, order = _minfrag_cluster()
+    args = (Resources.of("1", "1Gi"), Resources.of("2", "1Gi"), 6, order, order, metadata)
+    strict = select_binpacker("minimal-fragmentation").binpack_func(*args)
+    corrected = select_binpacker(
+        "minimal-fragmentation", strict_reference_parity=False
+    ).binpack_func(*args)
+    assert strict.executor_nodes == corrected.executor_nodes  # decisions equal
+    exec_nodes = set(strict.executor_nodes) - {strict.driver_node}
+    assert exec_nodes
+    for n in exec_nodes:
+        assert strict.packing_efficiencies[n].cpu == 0.0
+        assert corrected.packing_efficiencies[n].cpu > 0.0
+
+
+def _overhead_pod(node: str, cpu: str, mem: str) -> Pod:
+    """A scheduled non-spark pod: contributes overhead on its node."""
+    return Pod(
+        meta=ObjectMeta(name=f"sys-{node}", namespace="kube-system"),
+        node_name=node,
+        phase=PodPhase.RUNNING,
+        containers=[Container(requests=Resources.of(cpu, mem))],
+    )
+
+
+@pytest.mark.parametrize("strict,expect_extra", [(True, False), (False, True)])
+def test_reschedule_overhead_quirk_switch(strict, expect_extra):
+    """One 7-CPU node: reservations 2 CPU (driver 1 + executor 1),
+    overhead 3 CPU, extra executor wants 1 CPU.  Strict parity
+    double-counts overhead on reserved nodes (7−2−6=−1 → reject);
+    corrected counts it once (7−2−3=2 → accept).
+    Reference resource.go:638-643."""
+    install = Install(
+        fifo=False,
+        binpack_algo="tightly-pack",
+        strict_reference_parity=strict,
+    )
+    h = Harness(extra_install=install)
+    try:
+        h.new_node("n1", cpu="7", memory="64Gi")
+        h.create_pod(_overhead_pod("n1", "3", "1Gi"))
+
+        # DA app min=1 max=2: the second executor takes the
+        # reschedule/extra-executor path (resource.go:594-673)
+        pods = h.dynamic_allocation_spark_pods("app-oh", 1, 2)
+        h.assert_success(h.schedule(pods[0], ["n1"]))
+        h.assert_success(h.schedule(pods[1], ["n1"]))
+
+        result = h.schedule(pods[2], ["n1"])
+        if expect_extra:
+            h.assert_success(result)
+        else:
+            h.assert_failure(result)
+    finally:
+        h.close()

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Large-scale device-vs-oracle differential fuzz (the BASELINE gate:
+zero gang-feasibility regressions, SURVEY §6).
+
+Random clusters (heterogeneous sizes, zones, unschedulable nodes, GPU
+rows, fractional quantities) × random gangs, solved by every device
+policy and compared decision-for-decision (has_capacity, driver node,
+exact executor list) against its host oracle.  Any mismatch is a
+failure.  CI runs a modest budget; scale --trials for soak runs.
+
+    python tools/parity_fuzz.py --trials 150 --seed 987654
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# parity is platform-independent integer math; CPU keeps the fuzz
+# immune to the dev relay (utils/tpuprobe.py notes)
+jax.config.update("jax_platforms", "cpu")
+
+from k8s_spark_scheduler_tpu.ops import packers
+from k8s_spark_scheduler_tpu.ops.batch_adapter import (
+    TpuBatchBinpacker,
+    TpuSingleAzBinpacker,
+)
+from k8s_spark_scheduler_tpu.ops.nodesort import NodeSorter
+from k8s_spark_scheduler_tpu.types.resources import (
+    NodeSchedulingMetadata,
+    Resources,
+)
+
+PAIRS = [
+    ("tightly-pack", TpuBatchBinpacker("tightly-pack"), packers.tightly_pack),
+    (
+        "distribute-evenly",
+        TpuBatchBinpacker("distribute-evenly"),
+        packers.distribute_evenly,
+    ),
+    (
+        "minimal-fragmentation",
+        TpuBatchBinpacker("minimal-fragmentation"),
+        packers.minimal_fragmentation_pack,
+    ),
+    (
+        "minimal-fragmentation/corrected",  # strict-reference-parity: false
+        TpuBatchBinpacker("minimal-fragmentation", strict_reference_parity=False),
+        packers.make_minimal_fragmentation_pack(False),
+    ),
+    (
+        "single-az-tightly-pack",
+        TpuSingleAzBinpacker(az_aware=False),
+        packers.single_az_tightly_pack,
+    ),
+    (
+        "az-aware-tightly-pack",
+        TpuSingleAzBinpacker(az_aware=True),
+        packers.az_aware_tightly_pack,
+    ),
+]
+
+
+def random_cluster(rng: random.Random, n_nodes: int) -> dict:
+    metadata = {}
+    for i in range(n_nodes):
+        if rng.random() < 0.3:
+            cpu = f"{rng.randint(1, 64)}500m"
+        else:
+            cpu = str(rng.randint(1, 64))
+        if rng.random() < 0.3:
+            mem = f"{rng.randint(512, 65536)}Mi"
+        else:
+            mem = f"{rng.randint(1, 64)}Gi"
+        gpu = str(rng.randint(0, 8)) if rng.random() < 0.25 else "0"
+        metadata[f"n{i:04d}"] = NodeSchedulingMetadata(
+            available=Resources.of(cpu, mem, gpu),
+            schedulable=Resources.of("64", "64Gi", "8"),
+            zone_label=f"z{rng.randint(0, 3)}",
+            unschedulable=rng.random() < 0.08,
+            ready=rng.random() > 0.05,
+        )
+    return metadata
+
+
+def random_gang(rng: random.Random, n_nodes: int):
+    driver = Resources.of(
+        str(rng.randint(1, 4)), f"{rng.randint(1, 8)}Gi",
+        str(rng.randint(0, 1)) if rng.random() < 0.2 else "0",
+    )
+    executor = Resources.of(
+        str(rng.randint(1, 16)), f"{rng.randint(1, 16)}Gi",
+        str(rng.randint(0, 2)) if rng.random() < 0.2 else "0",
+    )
+    count = rng.randint(0, max(2 * n_nodes, 4))
+    return driver, executor, count
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=150)
+    ap.add_argument("--seed", type=int, default=987654)
+    ap.add_argument("--min-nodes", type=int, default=3)
+    ap.add_argument("--max-nodes", type=int, default=700)
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    sorter = NodeSorter()
+    mismatches = 0
+    comparisons = 0
+    t0 = time.time()
+    for trial in range(args.trials):
+        n_nodes = rng.randint(args.min_nodes, args.max_nodes)
+        metadata = random_cluster(rng, n_nodes)
+        driver_order, executor_order = sorter.potential_nodes(metadata, list(metadata))
+        driver_res, executor_res, count = random_gang(rng, n_nodes)
+        for name, device_fn, oracle_fn in PAIRS:
+            got = device_fn(
+                driver_res, executor_res, count, driver_order, executor_order, metadata
+            )
+            want = oracle_fn(
+                driver_res, executor_res, count, driver_order, executor_order, metadata
+            )
+            comparisons += 1
+            eff_mismatch = got.has_capacity and (
+                {
+                    n: (e.cpu, e.memory, e.gpu)
+                    for n, e in got.packing_efficiencies.items()
+                }
+                != {
+                    n: (e.cpu, e.memory, e.gpu)
+                    for n, e in want.packing_efficiencies.items()
+                }
+            )
+            if (
+                got.has_capacity != want.has_capacity
+                or got.driver_node != want.driver_node
+                or got.executor_nodes != want.executor_nodes
+                or eff_mismatch
+            ):
+                mismatches += 1
+                print(
+                    f"MISMATCH trial={trial} policy={name} nodes={n_nodes} "
+                    f"count={count}\n  device: {got.has_capacity} "
+                    f"{got.driver_node} {got.executor_nodes[:8]}...\n"
+                    f"  oracle: {want.has_capacity} {want.driver_node} "
+                    f"{want.executor_nodes[:8]}...",
+                    file=sys.stderr,
+                )
+        if (trial + 1) % 25 == 0:
+            print(
+                f"# {trial + 1}/{args.trials} trials, {comparisons} comparisons, "
+                f"{mismatches} mismatches, {time.time() - t0:.0f}s",
+                file=sys.stderr,
+            )
+    print(
+        f"parity fuzz: {comparisons} comparisons over {args.trials} trials, "
+        f"{mismatches} mismatches"
+    )
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
