@@ -53,6 +53,14 @@ class APIServer:
         # registered CRD kinds → established flag
         self._crds: Dict[str, dict] = {}
 
+    @property
+    def resource_version(self) -> int:
+        """The current global revision (etcd's header revision analog);
+        list responses must carry this even when empty, so a watch
+        resumed from a list never silently skips a truncated history."""
+        with self._lock:
+            return self._rv
+
     # -- namespace lifecycle ------------------------------------------------
 
     def mark_namespace_terminating(self, namespace: str) -> None:

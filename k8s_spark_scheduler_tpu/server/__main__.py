@@ -37,6 +37,26 @@ def main(argv=None) -> int:
         action="store_true",
         help="serve only the CRD conversion webhook (standalone module)",
     )
+    # backend selection (reference cmd/clients.go:37-44: kubeconfig path
+    # or in-cluster config; default here is the embedded store for
+    # single-process runs and demos)
+    parser.add_argument(
+        "--kubeconfig",
+        type=str,
+        default=None,
+        help="connect to the cluster in this kubeconfig (real-cluster mode)",
+    )
+    parser.add_argument(
+        "--kube-context",
+        type=str,
+        default=None,
+        help="kubeconfig context override",
+    )
+    parser.add_argument(
+        "--in-cluster",
+        action="store_true",
+        help="use the pod service account to reach the API server",
+    )
     args = parser.parse_args(argv)
 
     if args.version:
@@ -108,16 +128,37 @@ def main(argv=None) -> int:
         else:
             install = Install.from_dict(json.loads(raw))
 
-    api = APIServer()
+    if args.in_cluster or args.kubeconfig:
+        # install.qps/burst are applied by the wiring's shared write-back
+        # token bucket (clients.go:53-54 analog); the REST client's own
+        # bucket stays off so the limit isn't double-counted
+        from ..kube.restbackend import RestAPIServer
+        from ..kube.restclient import in_cluster_config, load_kubeconfig
+
+        if args.in_cluster:
+            cluster = in_cluster_config()
+        else:
+            cluster = load_kubeconfig(args.kubeconfig, args.kube_context)
+        api = RestAPIServer(cluster)
+        backend_desc = f"kubernetes {cluster.host}"
+    else:
+        api = APIServer()
+        backend_desc = "embedded"
     scheduler = init_server_with_clients(api, install)
     http = ExtenderHTTPServer(scheduler, port=args.port, host=args.host)
     http.start()
-    print(f"extender serving on :{http.port} (binpack={install.binpack_algo})", flush=True)
+    print(
+        f"extender serving on :{http.port} "
+        f"(binpack={install.binpack_algo}, backend={backend_desc})",
+        flush=True,
+    )
     try:
         stop_event.wait()
     finally:
         http.stop()
         scheduler.stop()
+        if hasattr(api, "close"):
+            api.close()
     return 0
 
 

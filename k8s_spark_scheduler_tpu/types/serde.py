@@ -22,8 +22,11 @@ from .objects import (
     DemandSpec,
     DemandStatus,
     DemandUnit,
+    Node,
     ObjectMeta,
+    OwnerReference,
     Pod,
+    PodCondition,
     Reservation,
     ResourceReservation,
     ResourceReservationSpec,
@@ -40,7 +43,39 @@ RESERVATION_SPEC_ANNOTATION_KEY = GROUP_NAME + "/reservation-spec"
 # ---------------------------------------------------------------------------
 
 
+def ts_to_rfc3339(ts: float) -> str:
+    """k8s metav1.Time wire form (UTC, second precision)."""
+    import datetime
+
+    return (
+        datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+    )
+
+
+def _ts_from_wire(value) -> float:
+    """Accept the embedded wire's float timestamps AND k8s RFC3339."""
+    if value is None:
+        return 0.0
+    if isinstance(value, (int, float)):
+        return float(value)
+    import datetime
+
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        pass
+    try:
+        return datetime.datetime.strptime(
+            str(value), "%Y-%m-%dT%H:%M:%SZ"
+        ).replace(tzinfo=datetime.timezone.utc).timestamp()
+    except ValueError:
+        return 0.0
+
+
 def meta_to_dict(meta: ObjectMeta) -> dict:
+    """Embedded-wire form (float timestamps); the REST backend converts
+    to real k8s RFC3339 in one place (restbackend._k8s_wire)."""
     out: Dict[str, Any] = {
         "name": meta.name,
         "namespace": meta.namespace,
@@ -50,6 +85,17 @@ def meta_to_dict(meta: ObjectMeta) -> dict:
         "resourceVersion": str(meta.resource_version),
         "uid": meta.uid,
     }
+    if meta.owner_references:
+        out["ownerReferences"] = [
+            {
+                "apiVersion": "v1",
+                "kind": ref.kind,
+                "name": ref.name,
+                "uid": ref.uid,
+                "controller": ref.controller,
+            }
+            for ref in meta.owner_references
+        ]
     if meta.deletion_timestamp is not None:
         out["deletionTimestamp"] = meta.deletion_timestamp
     return out
@@ -61,15 +107,25 @@ def meta_from_dict(d: dict) -> ObjectMeta:
         rv = int(rv_raw)
     except (TypeError, ValueError):
         rv = 0
+    deletion = d.get("deletionTimestamp")
     return ObjectMeta(
         name=d.get("name", ""),
         namespace=d.get("namespace", "default"),
         labels=dict(d.get("labels") or {}),
         annotations=dict(d.get("annotations") or {}),
-        creation_timestamp=float(d.get("creationTimestamp") or 0.0),
-        deletion_timestamp=d.get("deletionTimestamp"),
+        creation_timestamp=_ts_from_wire(d.get("creationTimestamp")),
+        deletion_timestamp=_ts_from_wire(deletion) if deletion is not None else None,
         resource_version=rv,
         uid=d.get("uid", ""),
+        owner_references=[
+            OwnerReference(
+                kind=ref.get("kind", ""),
+                name=ref.get("name", ""),
+                uid=ref.get("uid", ""),
+                controller=bool(ref.get("controller", True)),
+            )
+            for ref in d.get("ownerReferences") or []
+        ],
     )
 
 
@@ -109,6 +165,21 @@ def pod_from_dict(d: dict) -> Pod:
             )
         return out
 
+    conditions = {}
+    for c in status.get("conditions") or []:
+        ctype = c.get("type", "")
+        conditions[ctype] = PodCondition(
+            type=ctype,
+            status=c.get("status", ""),
+            reason=c.get("reason", ""),
+            message=c.get("message", ""),
+            transition_time=_ts_from_wire(c.get("lastTransitionTime")),
+        )
+    container_terminated = [
+        "terminated" in ((cs.get("state") or {}))
+        for cs in status.get("containerStatuses") or []
+    ]
+
     return Pod(
         meta=meta,
         scheduler_name=spec.get("schedulerName", ""),
@@ -122,6 +193,8 @@ def pod_from_dict(d: dict) -> Pod:
         # overhead for pods with large init steps
         init_containers=_containers("initContainers"),
         phase=status.get("phase", "Pending"),
+        container_terminated=container_terminated,
+        conditions=conditions,
     )
 
 
@@ -171,11 +244,63 @@ def pod_to_dict(pod: Pod) -> dict:
     }
     if pod.init_containers:
         spec["initContainers"] = _containers_to_dicts(pod.init_containers)
+    status: Dict[str, Any] = {"phase": pod.phase}
+    if pod.conditions:
+        status["conditions"] = [
+            {
+                "type": c.type,
+                "status": c.status,
+                "reason": c.reason,
+                "message": c.message,
+                "lastTransitionTime": c.transition_time,
+            }
+            for c in pod.conditions.values()
+        ]
+    if pod.container_terminated:
+        status["containerStatuses"] = [
+            {"state": {"terminated": {}} if t else {"running": {}}}
+            for t in pod.container_terminated
+        ]
     return {
         "metadata": meta_to_dict(pod.meta),
         "spec": spec,
-        "status": {"phase": pod.phase},
+        "status": status,
     }
+
+
+# ---------------------------------------------------------------------------
+# Node (k8s core/v1 subset the scheduler reads:
+# status.allocatable, spec.unschedulable, the Ready condition)
+# ---------------------------------------------------------------------------
+
+
+def node_to_dict(node: Node) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": meta_to_dict(node.meta),
+        "spec": {"unschedulable": node.unschedulable} if node.unschedulable else {},
+        "status": {
+            "allocatable": node.allocatable.to_dict(),
+            "conditions": [
+                {"type": "Ready", "status": "True" if node.ready else "False"}
+            ],
+        },
+    }
+
+
+def node_from_dict(d: dict) -> Node:
+    status = d.get("status") or {}
+    ready = False
+    for c in status.get("conditions") or []:
+        if c.get("type") == "Ready":
+            ready = c.get("status") == "True"
+    return Node(
+        meta=meta_from_dict(d.get("metadata") or {}),
+        allocatable=Resources.from_dict(status.get("allocatable") or {}),
+        unschedulable=bool((d.get("spec") or {}).get("unschedulable", False)),
+        ready=ready,
+    )
 
 
 # ---------------------------------------------------------------------------
