@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Generate a CA + server certificate for the extender / conversion
+# webhook (the apiserver only dials conversion webhooks over HTTPS with
+# a trusted caBundle).  Analog of the reference's dev cert tooling,
+# written for this framework's install shape:
+#
+#   hack/generate-certs.sh [OUTDIR] [SERVICE_NAME] [SERVICE_NAMESPACE]
+#
+# Produces in OUTDIR (default ./certs):
+#   ca.crt ca.key      — the CA; base64 of ca.crt goes in the CRD's
+#                        conversion clientConfig caBundle (or point the
+#                        install's conversion-webhook.ca-bundle-file at
+#                        ca.crt and the server does it for you)
+#   server.crt server.key — serve with --tls-cert/--tls-key
+#
+# SANs cover the in-cluster service DNS names plus localhost for local
+# runs.
+set -euo pipefail
+
+OUTDIR="${1:-certs}"
+SERVICE="${2:-spark-scheduler}"
+NAMESPACE="${3:-spark}"
+DAYS="${DAYS:-3650}"
+
+mkdir -p "$OUTDIR"
+cd "$OUTDIR"
+
+openssl genrsa -out ca.key 2048 >/dev/null 2>&1
+openssl req -x509 -new -nodes -key ca.key -subj "/CN=${SERVICE}-ca" \
+  -days "$DAYS" -out ca.crt
+
+cat > server.conf <<EOF
+[req]
+distinguished_name = dn
+req_extensions = ext
+prompt = no
+[dn]
+CN = ${SERVICE}.${NAMESPACE}.svc
+[ext]
+subjectAltName = @alt_names
+[alt_names]
+DNS.1 = ${SERVICE}
+DNS.2 = ${SERVICE}.${NAMESPACE}
+DNS.3 = ${SERVICE}.${NAMESPACE}.svc
+DNS.4 = ${SERVICE}.${NAMESPACE}.svc.cluster.local
+DNS.5 = localhost
+IP.1 = 127.0.0.1
+EOF
+
+openssl genrsa -out server.key 2048 >/dev/null 2>&1
+openssl req -new -key server.key -out server.csr -config server.conf
+openssl x509 -req -in server.csr -CA ca.crt -CAkey ca.key -CAcreateserial \
+  -days "$DAYS" -extensions ext -extfile server.conf -out server.crt >/dev/null 2>&1
+rm -f server.csr server.conf ca.srl
+
+echo "wrote $OUTDIR/{ca.crt,ca.key,server.crt,server.key}"
+echo "caBundle (for a hand-written CRD): $(openssl base64 -A < ca.crt | head -c 48)..."

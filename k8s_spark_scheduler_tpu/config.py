@@ -32,6 +32,21 @@ class AsyncClientConfig:
 
 
 @dataclass
+class ConversionWebhookConfig:
+    """Where the apiserver reaches the CRD conversion webhook (the
+    reference wires this from the witchcraft server's service identity,
+    conversionwebhook/resource_reservation.go:44-98).  ca_bundle_file
+    holds the PEM CA the apiserver must trust — conversion is HTTPS-only
+    on a real cluster."""
+
+    service_namespace: str = "spark"
+    service_name: str = "spark-scheduler"
+    service_port: int = 443
+    path: str = "/convert"
+    ca_bundle_file: Optional[str] = None
+
+
+@dataclass
 class Install:
     """config.go:24-47."""
 
@@ -47,6 +62,7 @@ class Install:
     driver_prioritized_node_label: Optional[LabelPriorityOrder] = None
     executor_prioritized_node_label: Optional[LabelPriorityOrder] = None
     resource_reservation_crd_annotations: Dict[str, str] = field(default_factory=dict)
+    conversion_webhook: Optional[ConversionWebhookConfig] = None
     # replicate the reference's accidental-but-load-bearing behaviors
     # (see compat.py for the list); off = corrected semantics
     strict_reference_parity: bool = compat.DEFAULT_STRICT
@@ -96,6 +112,25 @@ class Install:
             ),
             resource_reservation_crd_annotations=d.get(
                 "resource-reservation-crd-annotations", {}
+            ),
+            # only present keys are passed so the dataclass defaults stay
+            # the single source of truth
+            conversion_webhook=(
+                ConversionWebhookConfig(
+                    **{
+                        field_name: wh[key]
+                        for key, field_name in (
+                            ("service-namespace", "service_namespace"),
+                            ("service-name", "service_name"),
+                            ("service-port", "service_port"),
+                            ("path", "path"),
+                            ("ca-bundle-file", "ca_bundle_file"),
+                        )
+                        if key in wh
+                    }
+                )
+                if (wh := d.get("conversion-webhook")) is not None
+                else None
             ),
             strict_reference_parity=d.get(
                 "strict-reference-parity", compat.DEFAULT_STRICT

@@ -57,7 +57,15 @@ def main(argv=None) -> int:
         action="store_true",
         help="use the pod service account to reach the API server",
     )
+    # HTTPS serving: required for the CRD conversion webhook on a real
+    # cluster (the apiserver only dials webhooks over TLS) and supported
+    # by kube-scheduler's extender tlsConfig
+    parser.add_argument("--tls-cert", type=str, default=None, help="PEM server certificate")
+    parser.add_argument("--tls-key", type=str, default=None, help="PEM server private key")
     args = parser.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        print("--tls-cert and --tls-key must be given together", file=sys.stderr)
+        return 2
 
     if args.version:
         print(__version__)
@@ -101,9 +109,17 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _on_signal)
 
     if args.webhook_only:
-        http = ExtenderHTTPServer(None, port=args.port, webhook_only=True, host=args.host)
+        http = ExtenderHTTPServer(
+            None,
+            port=args.port,
+            webhook_only=True,
+            host=args.host,
+            tls_cert_file=args.tls_cert,
+            tls_key_file=args.tls_key,
+        )
         http.start()
-        print(f"conversion webhook serving on :{http.port}", flush=True)
+        scheme = "https" if http.tls else "http"
+        print(f"conversion webhook serving on :{http.port} ({scheme})", flush=True)
         stop_event.wait()
         http.stop()
         return 0
@@ -145,11 +161,18 @@ def main(argv=None) -> int:
         api = APIServer()
         backend_desc = "embedded"
     scheduler = init_server_with_clients(api, install)
-    http = ExtenderHTTPServer(scheduler, port=args.port, host=args.host)
+    http = ExtenderHTTPServer(
+        scheduler,
+        port=args.port,
+        host=args.host,
+        tls_cert_file=args.tls_cert,
+        tls_key_file=args.tls_key,
+    )
     http.start()
     print(
         f"extender serving on :{http.port} "
-        f"(binpack={install.binpack_algo}, backend={backend_desc})",
+        f"(binpack={install.binpack_algo}, backend={backend_desc}, "
+        f"tls={'on' if http.tls else 'off'})",
         flush=True,
     )
     try:

@@ -146,6 +146,8 @@ class ExtenderHTTPServer:
         port: int = 0,
         webhook_only: bool = False,
         host: str = "",
+        tls_cert_file: Optional[str] = None,
+        tls_key_file: Optional[str] = None,
     ):
         # host="" binds all interfaces: kube-scheduler and the apiserver
         # webhook dial the pod IP, not loopback
@@ -155,6 +157,19 @@ class ExtenderHTTPServer:
             {"scheduler": scheduler, "webhook_only": webhook_only},
         )
         self._httpd = _ExtenderHTTPD((host, port), handler)
+        if tls_cert_file:
+            # the apiserver only calls conversion webhooks over HTTPS
+            # with a CA it trusts (ref conversionwebhook/resource_
+            # reservation.go:44-98); kube-scheduler extenders support
+            # enableHTTPS + tlsConfig the same way
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
+        self.tls = bool(tls_cert_file)
         self._thread: Optional[threading.Thread] = None
 
     @property

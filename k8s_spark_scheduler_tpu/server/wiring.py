@@ -167,7 +167,11 @@ def init_server_with_clients(
     event_log = EventLog()
 
     # CRD ensure (cmd/server.go:83-85)
-    crd.ensure_resource_reservations_crd(api, install.resource_reservation_crd_annotations)
+    crd.ensure_resource_reservations_crd(
+        api,
+        install.resource_reservation_crd_annotations,
+        conversion_webhook=install.conversion_webhook,
+    )
 
     # informer factories + sync (cmd/server.go:91-127)
     factory = InformerFactory(api)
