@@ -59,6 +59,12 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "tpu-gang-scheduler"
     scheduler: Optional[Server] = None
     webhook_only: bool = False
+    # per-connection socket timeout (applied by BaseHTTPRequestHandler.
+    # setup): bounds slow reads AND the deferred TLS handshake so a
+    # stalled peer only ties up its own worker thread, and only briefly.
+    # The kube-scheduler extender client gives up after 30s
+    # (examples/extender.yml httpTimeout), so 65s is a safe outer bound.
+    timeout = 65
 
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug("http: " + fmt, *args)
@@ -166,8 +172,14 @@ class ExtenderHTTPServer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            # do_handshake_on_connect=False: the handshake must NOT run
+            # inside accept() in the single serve_forever thread — a peer
+            # that connects and never sends a ClientHello (port scanner,
+            # TCP probe) would wedge the whole server.  Deferred, the
+            # handshake happens on first read inside the per-connection
+            # worker thread, bounded by the handler's socket timeout.
             self._httpd.socket = ctx.wrap_socket(
-                self._httpd.socket, server_side=True
+                self._httpd.socket, server_side=True, do_handshake_on_connect=False
             )
         self.tls = bool(tls_cert_file)
         self._thread: Optional[threading.Thread] = None
