@@ -229,6 +229,180 @@ def solve_single(
     return solve_app(avail, driver_rank, exec_ok, driver, executor, k)
 
 
+class ZoneQueueSolve(NamedTuple):
+    """Per-app outcome of the fused single-AZ FIFO scan."""
+
+    feasible: jnp.ndarray    # [A] bool
+    zone_idx: jnp.ndarray    # [A] int32 — chosen zone; Z = cross-zone fallback, -1 = none
+    driver_idx: jnp.ndarray  # [A] int32
+    uncertain: jnp.ndarray   # [A] bool — zone choice within the fixed-point margin
+    avail_after: jnp.ndarray  # [N, 3] int32
+
+
+# Fixed-point bits for the on-device zone-efficiency score.  The zone
+# choice (single_az.go:75-97: highest average of per-occurrence max
+# packing efficiency, strict improvement in zone order) is computed as
+# Q_z = Σ_n w_n · round(2^EFF_SHIFT · maxEff_n) with integer weights
+# w_n = executor count + driver indicator.  Because every feasible zone
+# places exactly k executors + 1 driver, comparing averages equals
+# comparing these sums.  Per-term quantization error is < 0.6 fixed-point
+# ulps, so |Q_a − Q_b| > 2(k+1)+2 certifies that the float64 oracle
+# orders the true sums the same way; equal Q keeps the earlier zone
+# (identical to Go for mathematically equal scores), and distinct-but-
+# closer scores raise `uncertain` and the caller re-solves on the exact
+# host path.  See docs/design.md § "Single-AZ zone choice on device".
+EFF_SHIFT = 18
+
+
+def _zone_score(
+    carry_avail: jnp.ndarray,  # [N, 3] int32 scaled
+    solve: AppSolve,
+    driver: jnp.ndarray,
+    executor: jnp.ndarray,
+    s_cpu_milli: jnp.ndarray,  # [N] int32 schedulable cpu, base milli units
+    s_gpu_milli: jnp.ndarray,  # [N] int32
+    inv_mem: jnp.ndarray,      # [N] f32 = scale_mem / schedulable_mem_bytes
+    th_mem: jnp.ndarray,       # [N] int32 = ceil(sched_mem_bytes / scale_mem)
+    scale_cpu: jnp.ndarray,    # [] int32
+    scale_gpu: jnp.ndarray,    # [] int32
+):
+    """(Q, nonzero): the fixed-point zone score for one zone's packing and
+    the exact S > 0 indicator (efficiency.go:80-156 semantics: value()
+    ceil to cores for cpu/gpu, bytes for memory; gpu efficiency 0 on
+    gpu-less nodes; per-node max over dims; occurrence-weighted sum)."""
+    n = carry_avail.shape[0]
+    is_driver = (jnp.arange(n, dtype=jnp.int32) == solve.driver_idx) & solve.feasible
+    counts = solve.exec_counts
+    w = counts + is_driver.astype(jnp.int32)
+    new = counts[:, None] * executor[None, :] + jnp.where(
+        is_driver[:, None], driver[None, :], 0
+    )
+    m = carry_avail - new  # scaled availability net of this packing; ≥ 0 where w > 0
+
+    # reserved numerators in exact base units (bounded int32 by the
+    # caller's guards): r_dim = sched_base − m·scale
+    num_cq = s_cpu_milli - m[:, 0] * scale_cpu
+    num_gq = s_gpu_milli - m[:, 2] * scale_gpu
+    num_cores = lax.div(num_cq + 999, jnp.int32(1000))
+    num_gcores = lax.div(num_gq + 999, jnp.int32(1000))
+    den_cores = jnp.maximum(lax.div(s_cpu_milli + 999, jnp.int32(1000)), 1)
+    den_gcores = jnp.maximum(lax.div(s_gpu_milli + 999, jnp.int32(1000)), 1)
+    has_gpu = s_gpu_milli > 0
+
+    ratio_c = num_cores.astype(jnp.float32) / den_cores.astype(jnp.float32)
+    ratio_g = jnp.where(
+        has_gpu, num_gcores.astype(jnp.float32) / den_gcores.astype(jnp.float32), 0.0
+    )
+    ratio_m = jnp.maximum(1.0 - m[:, 1].astype(jnp.float32) * inv_mem, 0.0)
+    eff = jnp.maximum(jnp.maximum(ratio_c, ratio_m), ratio_g)
+    q = jnp.floor(eff * jnp.float32(2**EFF_SHIFT) + 0.5).astype(jnp.int32)
+    score = jnp.sum(jnp.where(w > 0, w * q, 0))
+    # exact S > 0: some occupied node has a strictly positive reserved
+    # quantity in a dimension that counts (the all-zero-efficiency quirk)
+    nonzero = jnp.any(
+        (w > 0) & ((num_cq > 0) | (m[:, 1] < th_mem) | (has_gpu & (num_gq > 0)))
+    )
+    return score, nonzero
+
+
+@functools.partial(jax.jit, static_argnames=("az_aware",))
+def solve_queue_single_az(
+    avail: jnp.ndarray,        # [N, 3] int32
+    driver_rank: jnp.ndarray,  # [N] int32
+    exec_ok: jnp.ndarray,      # [N] bool
+    zone_masks: jnp.ndarray,   # [Z, N] bool
+    drivers: jnp.ndarray,      # [A, 3] int32
+    executors: jnp.ndarray,    # [A, 3] int32
+    counts: jnp.ndarray,       # [A] int32
+    app_valid: jnp.ndarray,    # [A] bool
+    s_cpu_milli: jnp.ndarray,  # [N] int32
+    s_gpu_milli: jnp.ndarray,  # [N] int32
+    inv_mem: jnp.ndarray,      # [N] f32
+    th_mem: jnp.ndarray,       # [N] int32
+    scale_cpu: jnp.ndarray,    # [] int32
+    scale_gpu: jnp.ndarray,    # [] int32
+    az_aware: bool = False,
+) -> ZoneQueueSolve:
+    """Whole-FIFO-queue single-AZ gang solve in ONE dispatch
+    (single_az.go:23-97 × resource.go:224-262): scan apps in order; each
+    step solves every zone (inner tightly-pack), scores feasible zones
+    with the fixed-point efficiency comparator (see EFF_SHIFT), applies
+    the strict-improvement choice in zone order, optionally falls back
+    to a cross-zone pack (az_aware_pack_tightly.go:27-38), and carries
+    availability with the reference's subtraction quirk."""
+    n = avail.shape[0]
+    z_count = zone_masks.shape[0]
+
+    def step(carry_avail, app):
+        driver, executor, k, valid = app
+        band = 2 * (k + 1) + 2
+
+        best_q = jnp.int32(0)
+        best_zone = jnp.int32(-1)
+        uncertain = jnp.zeros((), bool)
+        chosen_counts = jnp.zeros((n,), jnp.int32)
+        chosen_didx = jnp.int32(n)
+
+        for z in range(z_count):
+            mask = zone_masks[z]
+            solve = solve_app(
+                carry_avail,
+                jnp.where(mask, driver_rank, BIG),
+                exec_ok & mask,
+                driver,
+                executor,
+                k,
+            )
+            score, nz = _zone_score(
+                carry_avail, solve, driver, executor,
+                s_cpu_milli, s_gpu_milli, inv_mem, th_mem, scale_cpu, scale_gpu,
+            )
+            f = solve.feasible
+            first = best_zone < 0
+            better = f & jnp.where(first, nz, score > best_q)
+            uncertain = uncertain | (
+                f & ~first & (score != best_q) & (jnp.abs(score - best_q) <= band)
+            )
+            best_q = jnp.where(better, score, best_q)
+            best_zone = jnp.where(better, jnp.int32(z), best_zone)
+            chosen_counts = jnp.where(better, solve.exec_counts, chosen_counts)
+            chosen_didx = jnp.where(better, solve.driver_idx, chosen_didx)
+
+        if az_aware:
+            cross = solve_app(carry_avail, driver_rank, exec_ok, driver, executor, k)
+            use_cross = (best_zone < 0) & cross.feasible
+            best_zone = jnp.where(use_cross, jnp.int32(z_count), best_zone)
+            chosen_counts = jnp.where(use_cross, cross.exec_counts, chosen_counts)
+            chosen_didx = jnp.where(use_cross, cross.driver_idx, chosen_didx)
+
+        placed = (best_zone >= 0) & valid
+        chosen_counts = jnp.where(placed, chosen_counts, jnp.zeros_like(chosen_counts))
+        chosen_didx = jnp.where(placed, chosen_didx, jnp.int32(n))
+
+        # the reference's usage-subtraction quirk: one executor's worth on
+        # hosting nodes, executor entry overwriting the driver's
+        exec_mask = chosen_counts > 0
+        is_driver = jnp.arange(n, dtype=jnp.int32) == chosen_didx
+        delta = jnp.where(
+            exec_mask[:, None],
+            executor[None, :],
+            jnp.where(is_driver[:, None], driver[None, :], jnp.zeros_like(driver)[None, :]),
+        )
+        delta = jnp.where(placed, delta, jnp.zeros_like(delta))
+        out = (placed, jnp.where(placed, best_zone, jnp.int32(-1)), chosen_didx, uncertain)
+        return carry_avail - delta, out
+
+    avail_after, outs = lax.scan(step, avail, (drivers, executors, counts, app_valid))
+    placed, zone_idx, chosen_didx, uncertain = outs
+    return ZoneQueueSolve(
+        feasible=placed,
+        zone_idx=zone_idx,
+        driver_idx=chosen_didx,
+        uncertain=uncertain,
+        avail_after=avail_after,
+    )
+
+
 def solve_zones(
     avail: jnp.ndarray,        # [N, 3] int32
     driver_rank: jnp.ndarray,  # [N] int32

@@ -75,10 +75,27 @@ class FifoOutcome:
 
 
 class TpuFifoSolver:
-    """One device round for the whole FIFO queue + the current driver."""
+    """One device round for the whole FIFO queue + the current driver.
 
-    def __init__(self, assignment_policy: str = "tightly-pack"):
+    backend: "auto" (pallas kernel on TPU, XLA scan elsewhere), "xla", or
+    "pallas".  The pallas queue kernel (ops/pallas_queue) keeps the
+    availability carry VMEM-resident across the whole queue — it is the
+    program the headline bench measures, so production Filter requests
+    pay exactly the benched cost (queue pass + one O(N) decode solve for
+    the current driver's placements)."""
+
+    def __init__(self, assignment_policy: str = "tightly-pack", backend: str = "auto"):
         self.assignment_policy = assignment_policy
+        self.backend = backend
+
+    def _use_pallas(self) -> bool:
+        if self.backend == "pallas":
+            return True
+        if self.backend == "auto":
+            import jax
+
+            return jax.default_backend() == "tpu"
+        return False
 
     def solve(
         self,
@@ -121,7 +138,7 @@ class TpuFifoSolver:
             # whole-queue pass over the earlier drivers only
             queue_valid = problem.app_valid.copy()
             queue_valid[n_earlier:] = False
-            out = solve_queue(
+            queue_args = (
                 jnp.asarray(problem.avail),
                 jnp.asarray(problem.driver_rank),
                 jnp.asarray(problem.exec_ok),
@@ -129,16 +146,23 @@ class TpuFifoSolver:
                 jnp.asarray(problem.executor),
                 jnp.asarray(problem.count),
                 jnp.asarray(queue_valid),
-                evenly=evenly,
-                with_placements=False,
             )
-            feasible = np.asarray(out.feasible)[:n_earlier]
+            if self._use_pallas():
+                from .pallas_queue import pallas_solve_queue
+
+                feasible_dev, _, avail_after = pallas_solve_queue(
+                    *queue_args, evenly=evenly
+                )
+                feasible = np.asarray(feasible_dev)[:n_earlier]
+            else:
+                out = solve_queue(*queue_args, evenly=evenly, with_placements=False)
+                feasible = np.asarray(out.feasible)[:n_earlier]
+                avail_after = out.avail_after
             # an enforced (old-enough) earlier driver that doesn't fit
             # fails the whole request (resource.go:244-253)
             for i in range(n_earlier):
                 if not feasible[i] and not earlier_skip_allowed[i]:
                     return FifoOutcome(supported=True, earlier_ok=False)
-            avail_after = out.avail_after
         else:
             avail_after = jnp.asarray(problem.avail)
 
@@ -193,16 +217,82 @@ class TpuFifoSolver:
         return FifoOutcome(supported=True, earlier_ok=True, result=result)
 
 
+def _fused_efficiency_inputs(cluster, problem):
+    """Device inputs + numeric-range guards for the on-device zone-
+    efficiency score (batch_solver.solve_queue_single_az).  Returns None
+    when any bound fails and the host zone-choice loop must take over.
+    The bounds guarantee: int32 exactness of every reserved numerator
+    (r_base = sched_base − m·scale), f32 exactness of all ratio operands
+    (ints ≤ 2^24), ratios ≤ 1 (avail ≤ schedulable), and an int32-safe
+    score accumulator ((k+1)·2^EFF_SHIFT < 2^31)."""
+    import jax.numpy as jnp
+
+    n = len(cluster.node_names)
+    nb = problem.avail.shape[0]
+    sched = cluster.sched[:n]  # int64 base units (milli-cpu, bytes, milli-gpu)
+    avail_base = cluster.avail[:n]
+    scale = problem.scale.astype(np.int64)
+    k_max = int(problem.count.max()) if problem.count.size else 0
+    if k_max + 1 > 4096:
+        return None
+    if n == 0:
+        return None
+    if (sched[:, 0] <= 0).any() or (sched[:, 1] <= 0).any():
+        # zero-schedulable dims hit the normalize(0)→1 divisor and can
+        # produce efficiencies ≫ 1 — exact f64 host path handles those
+        return None
+    if (sched[:, 0] > 2**31 - 1024).any() or (sched[:, 2] > 2**31 - 1024).any():
+        return None
+    if (avail_base > sched).any():
+        return None
+    if int(scale[0]) > 2**31 - 1 or int(scale[2]) > 2**31 - 1:
+        return None
+    th_mem = -(-sched[:, 1] // int(scale[1]))
+    den_c = -(-sched[:, 0] // 1000)
+    den_g = -(-sched[:, 2] // 1000)
+    if (th_mem > 2**24).any() or (den_c > 2**24).any() or (den_g > 2**24).any():
+        return None
+
+    s_cpu = np.zeros(nb, np.int32)
+    s_cpu[:n] = sched[:, 0]
+    s_gpu = np.zeros(nb, np.int32)
+    s_gpu[:n] = sched[:, 2]
+    inv_m = np.zeros(nb, np.float32)
+    inv_m[:n] = (float(scale[1]) / sched[:, 1].astype(np.float64)).astype(np.float32)
+    th = np.zeros(nb, np.int32)
+    th[:n] = th_mem
+    return (
+        jnp.asarray(s_cpu),
+        jnp.asarray(s_gpu),
+        jnp.asarray(inv_m),
+        jnp.asarray(th),
+        jnp.int32(int(scale[0])),
+        jnp.int32(int(scale[2])),
+    )
+
+
 class TpuSingleAzFifoSolver:
-    """FIFO pass for the single-AZ policies: each earlier driver's
-    per-zone gang solves run in ONE vmapped device call (solve_zones);
-    the zone choice (float64 efficiency, oracle functions) and the
-    carried usage subtraction (exact scaled ints with the reference's
-    overwrite quirk) run on host.  az_aware adds the cross-zone fallback
-    for each driver (az_aware_pack_tightly.go:27-38)."""
+    """FIFO pass for the single-AZ policies.
+
+    Fast lane (one dispatch): batch_solver.solve_queue_single_az scans
+    the whole earlier-driver queue on device — per-zone tightly-pack
+    solves, the zone-efficiency choice in certified fixed point
+    (batch_solver.EFF_SHIFT), the az-aware cross-zone fallback, and the
+    carried usage subtraction all fused into a single XLA program.
+
+    Exactness valve: any app whose zone scores land inside the
+    fixed-point margin is flagged `uncertain`, and the whole queue is
+    re-solved on the host lane — per-driver vmapped zone solves
+    (solve_zones) with the zone choice in the oracle's float64
+    efficiency math — restoring bit-exact reference parity.  Snapshots
+    outside the fused lane's numeric bounds (_fused_efficiency_inputs)
+    go straight to the host lane.  The current app's packing is always
+    chosen with the exact host math.  `last_path` records which lane ran
+    ("fused" / "host") for tests and diagnostics."""
 
     def __init__(self, az_aware: bool = False):
         self.az_aware = az_aware
+        self.last_path: Optional[str] = None
 
     def solve(
         self,
@@ -216,13 +306,14 @@ class TpuSingleAzFifoSolver:
         import jax.numpy as jnp
 
         from . import packers
-        from .batch_solver import solve_zones_jit
+        from .batch_solver import solve_queue_single_az, solve_zones_jit
 
         cluster = tensorize_cluster(metadata, driver_order, executor_order)
         all_apps = list(earlier_apps) + [current_app]
         apps = tensorize_apps(all_apps)
         problem = scale_problem(cluster, apps)
         if not problem.ok:
+            self.last_path = None
             return FifoOutcome(supported=False)
 
         names = cluster.node_names
@@ -294,17 +385,51 @@ class TpuSingleAzFifoSolver:
         def plain_fallback(app_idx):
             return self._plain_pack(app_idx, avail, problem, n)
 
-        for i, app in enumerate(earlier_apps):
-            packed = pack_one(i)
-            if packed is None and self.az_aware:
-                fallback = plain_fallback(i)
-                packed = fallback if fallback is None else (*fallback, None)
-            if packed is None:
-                if earlier_skip_allowed[i]:
-                    continue
-                return FifoOutcome(supported=True, earlier_ok=False)
-            d_idx, counts = packed[0], packed[1]
-            self._subtract(avail, d_idx, counts, problem, i, n)
+        n_earlier = len(earlier_apps)
+        fused_done = False
+        self.last_path = "fused"
+        if n_earlier > 0:
+            eff_inputs = _fused_efficiency_inputs(cluster, problem)
+            if eff_inputs is not None:
+                queue_valid = problem.app_valid.copy()
+                queue_valid[n_earlier:] = False
+                out = solve_queue_single_az(
+                    jnp.asarray(avail),
+                    rank_dev,
+                    exec_dev,
+                    zone_masks_dev,
+                    jnp.asarray(problem.driver),
+                    jnp.asarray(problem.executor),
+                    jnp.asarray(problem.count),
+                    jnp.asarray(queue_valid),
+                    *eff_inputs,
+                    az_aware=self.az_aware,
+                )
+                if not bool(np.asarray(out.uncertain)[:n_earlier].any()):
+                    feasible = np.asarray(out.feasible)[:n_earlier]
+                    for i in range(n_earlier):
+                        if not feasible[i] and not earlier_skip_allowed[i]:
+                            return FifoOutcome(supported=True, earlier_ok=False)
+                    # keep the closure binding: copy the carried result
+                    # into the same array pack_one reads
+                    avail[:] = np.asarray(out.avail_after)
+                    fused_done = True
+
+        if not fused_done and n_earlier > 0:
+            # host lane: per-driver vmapped zone solves with the exact
+            # float64 zone choice (the uncertainty/guard fallback)
+            self.last_path = "host"
+            for i, app in enumerate(earlier_apps):
+                packed = pack_one(i)
+                if packed is None and self.az_aware:
+                    fallback = plain_fallback(i)
+                    packed = fallback if fallback is None else (*fallback, None)
+                if packed is None:
+                    if earlier_skip_allowed[i]:
+                        continue
+                    return FifoOutcome(supported=True, earlier_ok=False)
+                d_idx, counts = packed[0], packed[1]
+                self._subtract(avail, d_idx, counts, problem, i, n)
 
         packed = pack_one(len(earlier_apps))
         if packed is None and self.az_aware:

@@ -168,6 +168,7 @@ def test_single_az_fifo_solver_parity(az_aware):
 
     rng = random.Random(60606 + az_aware)
     solver = TpuSingleAzFifoSolver(az_aware=az_aware)
+    fused_trials = 0
     for trial in range(20):
         metadata = random_cluster(rng, rng.randint(2, 18))
         driver_order, executor_order = orders_for(metadata, rng)
@@ -182,9 +183,123 @@ def test_single_az_fifo_solver_parity(az_aware):
             metadata, driver_order, executor_order, earlier, skip_allowed, current
         )
         assert outcome.supported
+        fused_trials += solver.last_path == "fused"
         assert outcome.earlier_ok == expected_ok, f"trial {trial}: earlier_ok"
         if expected_ok:
             assert outcome.result.has_capacity == expected.has_capacity, f"trial {trial}"
             if expected.has_capacity:
                 assert outcome.result.driver_node == expected.driver_node, f"trial {trial}"
                 assert outcome.result.executor_nodes == expected.executor_nodes, f"trial {trial}"
+    # the randomized clusters satisfy the fused lane's numeric bounds, so
+    # the one-dispatch path must actually be the one under test
+    assert fused_trials >= 10, f"fused lane engaged in only {fused_trials}/20 trials"
+
+
+def _two_zone_cluster(mem_avail_a, mem_avail_b, sched_mem="1000000"):
+    from k8s_spark_scheduler_tpu.types.resources import (
+        NodeSchedulingMetadata,
+        Resources,
+    )
+
+    return {
+        "a0": NodeSchedulingMetadata(
+            available=Resources.of("64", str(mem_avail_a)),
+            schedulable=Resources.of("64", sched_mem),
+            zone_label="z0",
+        ),
+        "a1": NodeSchedulingMetadata(
+            available=Resources.of("64", str(mem_avail_b)),
+            schedulable=Resources.of("64", sched_mem),
+            zone_label="z1",
+        ),
+    }
+
+
+def _byte_app(k=1, mem="100000"):
+    from k8s_spark_scheduler_tpu.types.resources import Resources
+
+    return AppDemand(
+        driver_resources=Resources.of("1", mem),
+        executor_resources=Resources.of("1", mem),
+        min_executor_count=k,
+    )
+
+
+def test_single_az_fused_symmetric_tie_keeps_first_zone():
+    """Mathematically equal zone scores (identical zones) stay on the
+    fused lane and pick the earlier zone, exactly like the float64
+    oracle's strict-improvement rule (single_az.go:88-94)."""
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import TpuSingleAzFifoSolver
+
+    metadata = _two_zone_cluster(600000, 600000)
+    order = ["a0", "a1"]
+    earlier = [_byte_app()]
+    current = _byte_app()
+    solver = TpuSingleAzFifoSolver(az_aware=False)
+    outcome = solver.solve(metadata, order, order, earlier, [False], current)
+    assert solver.last_path == "fused"
+    expected_ok, expected = host_single_az_fifo_oracle(
+        metadata, order, order, earlier, [False], current, az_aware=False
+    )
+    assert outcome.supported and outcome.earlier_ok == expected_ok
+    assert outcome.result.driver_node == expected.driver_node
+    assert outcome.result.executor_nodes == expected.executor_nodes
+
+
+def test_single_az_fused_near_tie_falls_back_to_host():
+    """Zone scores that are distinct but inside the fixed-point margin
+    must flag `uncertain`, re-solve on the exact host lane, and still
+    match the oracle decision-for-decision."""
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import TpuSingleAzFifoSolver
+
+    # efficiencies 0.6 vs 0.599995 — a 5e-6 gap, ~1.3 fixed-point ulps at
+    # EFF_SHIFT=18, far inside the 2(k+1)+2 certification band
+    metadata = _two_zone_cluster(600000, 600005)
+    order = ["a0", "a1"]
+    earlier = [_byte_app()]
+    current = _byte_app()
+    solver = TpuSingleAzFifoSolver(az_aware=False)
+    outcome = solver.solve(metadata, order, order, earlier, [False], current)
+    assert solver.last_path == "host"
+    expected_ok, expected = host_single_az_fifo_oracle(
+        metadata, order, order, earlier, [False], current, az_aware=False
+    )
+    assert outcome.supported and outcome.earlier_ok == expected_ok
+    assert outcome.result.driver_node == expected.driver_node
+    assert outcome.result.executor_nodes == expected.executor_nodes
+
+
+@pytest.mark.parametrize("az_aware", [False, True])
+def test_single_az_fused_matches_forced_host_lane(az_aware, monkeypatch):
+    """Differential: the fused one-dispatch lane and the per-driver host
+    lane must agree on every decision for queues where the fused lane is
+    certain (randomized, deeper queues than the oracle parity test)."""
+    from k8s_spark_scheduler_tpu.ops import fifo_solver as fs
+
+    rng = random.Random(424242 + az_aware)
+    for trial in range(8):
+        metadata = random_cluster(rng, rng.randint(4, 16))
+        driver_order, executor_order = orders_for(metadata, rng)
+        earlier = [random_app(rng) for _ in range(rng.randint(1, 10))]
+        skip_allowed = [rng.random() < 0.3 for _ in earlier]
+        current = random_app(rng)
+
+        solver = fs.TpuSingleAzFifoSolver(az_aware=az_aware)
+        fused = solver.solve(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        if solver.last_path != "fused":
+            continue
+        with monkeypatch.context() as m:
+            m.setattr(fs, "_fused_efficiency_inputs", lambda *a, **k: None)
+            host_solver = fs.TpuSingleAzFifoSolver(az_aware=az_aware)
+            host = host_solver.solve(
+                metadata, driver_order, executor_order, earlier, skip_allowed, current
+            )
+            assert host_solver.last_path == "host"
+        assert fused.earlier_ok == host.earlier_ok, f"trial {trial}"
+        if fused.earlier_ok:
+            assert fused.result.has_capacity == host.result.has_capacity, f"trial {trial}"
+            if fused.result.has_capacity:
+                assert fused.result.driver_node == host.result.driver_node, f"trial {trial}"
+                assert fused.result.executor_nodes == host.result.executor_nodes, f"trial {trial}"
