@@ -173,14 +173,14 @@ def _emit(
 ):
     import jax
 
-    # tiny smoke shapes can measure 0.0 after RTT subtraction; clamp so
-    # vs_baseline never divides by zero
-    p99 = max(float(np.percentile(lat, 99)), 1e-3)
+    p99 = float(np.percentile(lat, 99))
     result = {
         "metric": "p99_filter_latency_10k_nodes_x_1k_apps_batched_repack",
         "value": round(p99, 3),
         "unit": "ms",
-        "vs_baseline": round(TARGET_MS / p99, 3),
+        # the floor only guards the division (tiny smoke shapes can
+        # measure 0.0 after RTT subtraction); the reported value is raw
+        "vs_baseline": round(TARGET_MS / max(p99, 1e-3), 3),
     }
     line = json.dumps(result)
     # the worker's stdout is parsed by the parent (prefixed line); the

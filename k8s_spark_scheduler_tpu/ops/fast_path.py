@@ -46,6 +46,87 @@ def _label_ranks(labels_list, order: LabelPriorityOrder) -> np.ndarray:
     )
 
 
+def _base_priority_order(
+    snap: TensorSnapshot, idx: np.ndarray, avail: np.ndarray
+) -> np.ndarray:
+    """AZ-aware base node priority over the selected rows
+    (nodesorting.go:95-122), shared by the driver and executor fast
+    lanes: zones ascending by total (memory, cpu, name) of the selected
+    availability; nodes by (zone priority, memory, cpu, name).  Returns
+    positions into `idx`."""
+    zone_id = snap.zone_id[idx]
+    n_zones = len(snap.zone_names)
+    zone_mem = np.zeros(n_zones, dtype=np.int64)
+    zone_cpu = np.zeros(n_zones, dtype=np.int64)
+    np.add.at(zone_mem, zone_id, avail[:, 1])
+    np.add.at(zone_cpu, zone_id, avail[:, 0])
+    zone_name_rank = np.argsort(np.argsort(np.array(snap.zone_names, dtype=object)))
+    zone_order = np.lexsort((zone_name_rank, zone_cpu, zone_mem))
+    zone_priority = np.empty(n_zones, dtype=np.int64)
+    zone_priority[zone_order] = np.arange(n_zones)
+
+    # snapshot-maintained integer name ranks order exactly like the
+    # names; lexsort needs only the ordering, not dense subset ranks
+    return np.lexsort(
+        (snap.name_rank[idx], avail[:, 0], avail[:, 1], zone_priority[zone_id])
+    )
+
+
+def executor_reschedule_order(
+    snap: TensorSnapshot,
+    candidate_names: List[str],
+    executor_label_priority: Optional[LabelPriorityOrder] = None,
+    zone: Optional[str] = None,
+) -> Optional[Tuple[List[str], np.ndarray, np.ndarray, np.ndarray]]:
+    """Executor priority order + exact availability for the executor
+    reschedule path (resource.go:594-663): metadata restricted to the
+    kube-scheduler candidate list (optionally one zone for single-AZ
+    dynamic allocation), AZ-aware sort keyed on
+    avail = allocatable − usage − overhead, executor candidates
+    ready ∧ ¬unschedulable, then the label-priority stable re-sort.
+
+    Returns (names_in_order, avail_rows [M,3] int64, overhead_rows
+    [M,3] int64, reservation_entry_mask [M] bool) or None when the
+    snapshot is inexact.  Zone totals for the AZ sort are computed over
+    ALL candidate nodes (including not-ready ones), exactly like the
+    slow path's metadata."""
+    if not snap.exact:
+        return None
+    nidx = snap.name_index
+    rows = np.fromiter(
+        (nidx.get(nm, -1) for nm in candidate_names),
+        dtype=np.int64,
+        count=len(candidate_names),
+    )
+    idx = np.unique(rows[rows >= 0])  # dedupe like the slow path's metadata dict
+    if zone is not None:
+        try:
+            zi = snap.zone_names.index(zone)
+        except ValueError:
+            idx = idx[:0]
+        else:
+            idx = idx[snap.zone_id[idx] == zi]
+    if len(idx) == 0:
+        return [], np.zeros((0, 3), np.int64), np.zeros((0, 3), np.int64), np.zeros(0, bool)
+
+    avail = snap.avail[idx]
+    order = _base_priority_order(snap, idx, avail)
+
+    exec_ok = snap.ready[idx] & ~snap.unschedulable[idx]
+    order = order[exec_ok[order]]
+    if executor_label_priority is not None:
+        keys = _label_ranks([snap.labels[i] for i in idx], executor_label_priority)
+        order = order[np.argsort(keys[order], kind="stable")]
+
+    sel = idx[order]
+    return (
+        [snap.names[i] for i in sel],
+        avail[order],  # == snap.avail[sel] without re-materializing the property
+        snap.overhead[sel],
+        snap.res_entries[sel],
+    )
+
+
 def build_cluster_tensor(
     snap: TensorSnapshot,
     driver_pod,
@@ -107,20 +188,8 @@ def build_cluster_tensor(
     ready = snap.ready[idx]
     unsched = snap.unschedulable[idx]
 
-    # AZ totals over eligible nodes → zone priority (memory, cpu, name asc)
-    n_zones = len(snap.zone_names)
-    zone_mem = np.zeros(n_zones, dtype=np.int64)
-    zone_cpu = np.zeros(n_zones, dtype=np.int64)
-    np.add.at(zone_mem, zone_id, avail[:, 1])
-    np.add.at(zone_cpu, zone_id, avail[:, 0])
-    zone_name_rank = np.argsort(np.argsort(np.array(snap.zone_names, dtype=object)))
-    zone_order = np.lexsort((zone_name_rank, zone_cpu, zone_mem))
-    zone_priority = np.empty(n_zones, dtype=np.int64)
-    zone_priority[zone_order] = np.arange(n_zones)
-
-    # node priority: (zone priority, memory, cpu, name)
-    name_rank = np.argsort(np.argsort(np.array(names, dtype=object)))
-    order = np.lexsort((name_rank, avail[:, 0], avail[:, 1], zone_priority[zone_id]))
+    # AZ-aware base priority (shared with the executor lane)
+    order = _base_priority_order(snap, idx, avail)
 
     # per-role label-priority re-sort on top of the base order
     # (nodesorting.go:161-180).  The array order is the EXECUTOR priority

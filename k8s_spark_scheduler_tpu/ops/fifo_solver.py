@@ -247,9 +247,9 @@ def _fused_efficiency_inputs(cluster, problem):
         return None
     if int(scale[0]) > 2**31 - 1 or int(scale[2]) > 2**31 - 1:
         return None
-    th_mem = -(-sched[:, 1] // int(scale[1]))
-    den_c = -(-sched[:, 0] // 1000)
-    den_g = -(-sched[:, 2] // 1000)
+    th_mem = _ceil_div(sched[:, 1], int(scale[1]))
+    den_c = _ceil_div(sched[:, 0], 1000)
+    den_g = _ceil_div(sched[:, 2], 1000)
     if (th_mem > 2**24).any() or (den_c > 2**24).any() or (den_g > 2**24).any():
         return None
 
@@ -387,7 +387,9 @@ class TpuSingleAzFifoSolver:
 
         n_earlier = len(earlier_apps)
         fused_done = False
-        self.last_path = "fused"
+        # None = no queue pass ran (empty queue); "fused"/"host" report
+        # which lane actually processed earlier drivers
+        self.last_path = None
         if n_earlier > 0:
             eff_inputs = _fused_efficiency_inputs(cluster, problem)
             if eff_inputs is not None:
@@ -406,6 +408,10 @@ class TpuSingleAzFifoSolver:
                     az_aware=self.az_aware,
                 )
                 if not bool(np.asarray(out.uncertain)[:n_earlier].any()):
+                    # the one-dispatch lane's answer is certain — it is
+                    # the lane that served this request, whatever the
+                    # FIFO verdict
+                    self.last_path = "fused"
                     feasible = np.asarray(out.feasible)[:n_earlier]
                     for i in range(n_earlier):
                         if not feasible[i] and not earlier_skip_allowed[i]:

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from .. import compat
 from ..config import FifoConfig
 from ..demands.manager import DemandManager
@@ -125,6 +127,8 @@ class SparkSchedulerExtender:
         self._fast_path_ok = tensor_snapshot_cache is not None
         self._strict_reference_parity = strict_reference_parity
         self._last_request = 0.0
+        # diagnostics: which lane served the last executor reschedule
+        self.last_reschedule_path: Optional[str] = None
 
     # -- entry point ---------------------------------------------------------
 
@@ -671,17 +675,40 @@ class SparkSchedulerExtender:
         except AnnotationError as err:
             raise SchedulingFailure(FAILURE_INTERNAL, str(err))
         executor_resources = app_resources.executor_resources
-        available_nodes = self._get_nodes(node_names)
 
         should_schedule_into_single_az = False
         single_az_zone = ""
         if self.binpacker.is_single_az and self._single_az_da:
             zone, all_in_same_az = self._get_common_zone_for_executors_application(executor)
             if all_in_same_az:
-                available_nodes = self._filter_nodes_to_zone(available_nodes, zone)
-                node_names = [n.name for n in available_nodes]
                 single_az_zone = zone
                 should_schedule_into_single_az = True
+
+        potential_outcome = (
+            SUCCESS_SCHEDULED_EXTRA_EXECUTOR if is_extra_executor else SUCCESS_RESCHEDULED
+        )
+
+        # executor fast lane: order + fit from the event-driven tensor
+        # mirror, zero Quantity arithmetic and no O(all-reservations)
+        # usage walk (ref hot path resource.go:594-663)
+        fast = self._try_fast_reschedule(
+            executor,
+            node_names,
+            executor_resources,
+            single_az_zone if should_schedule_into_single_az else None,
+        )
+        if fast is not None:
+            hit, name = fast
+            if hit:
+                return name, potential_outcome
+            self._reschedule_miss(
+                executor, executor_resources, should_schedule_into_single_az, single_az_zone
+            )
+
+        available_nodes = self._get_nodes(node_names)
+        if should_schedule_into_single_az:
+            available_nodes = self._filter_nodes_to_zone(available_nodes, single_az_zone)
+            node_names = [n.name for n in available_nodes]
 
         usage = self._rrm.get_reserved_resources()
         overhead = self._overhead.get_overhead(available_nodes)
@@ -709,10 +736,6 @@ class SparkSchedulerExtender:
 
         _, executor_node_names = self._node_sorter.potential_nodes(metadata, node_names)
 
-        potential_outcome = (
-            SUCCESS_SCHEDULED_EXTRA_EXECUTOR if is_extra_executor else SUCCESS_RESCHEDULED
-        )
-
         if self.binpacker.name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
             name = self._reschedule_executor_with_minimal_fragmentation(
                 executor, executor_node_names, metadata, overhead, executor_resources
@@ -724,17 +747,83 @@ class SparkSchedulerExtender:
                 if not executor_resources.greater_than(available_resources[name]):
                     return name, potential_outcome
 
-        if should_schedule_into_single_az:
+        self._reschedule_miss(
+            executor, executor_resources, should_schedule_into_single_az, single_az_zone
+        )
+
+    def _reschedule_miss(
+        self, executor: Pod, executor_resources, into_single_az: bool, zone: str
+    ):
+        """Shared no-capacity tail of the reschedule path
+        (resource.go:664-672): demand creation + failure."""
+        if into_single_az:
             self._metrics.counter(
                 "foundry.spark.scheduler.single.az.dynamic.allocation.pack.failure",
-                {"zone": single_az_zone},
+                {"zone": zone},
             )
             self._demands.create_demand_for_executor_in_specific_zone(
-                executor, executor_resources, single_az_zone
+                executor, executor_resources, zone
             )
         else:
             self._demands.create_demand_for_executor_in_any_zone(executor, executor_resources)
         raise SchedulingFailure(FAILURE_FIT, "not enough capacity to reschedule the executor")
+
+    def _try_fast_reschedule(
+        self,
+        executor: Pod,
+        node_names: List[str],
+        executor_resources,
+        zone: Optional[str],
+    ):
+        """First-fit executor reschedule served entirely from the tensor
+        mirror: AZ-aware executor order (including label priority) and the
+        fit check in vectorized integer math.  Returns (hit, node_name)
+        or None to use the Quantity path.  Decision parity: availability
+        rows equal the slow path's alloc − reserved − overhead exactly
+        (tests/test_tensor_snapshot.py), the double-overhead reschedule
+        quirk applies to reservation-entry nodes under strict parity
+        (compat.py #1), and min-frag's app-attraction variant is not
+        tensorized (falls back)."""
+        self.last_reschedule_path = "slow"
+        if self._tensor_snapshot is None or not self._fast_path_ok:
+            return None
+        if self.binpacker.name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
+            return None
+        try:
+            from ..ops.fast_path import executor_reschedule_order
+            from ..ops.tensorize import _resources_to_base
+
+            snap = self._tensor_snapshot.snapshot()
+            if not snap.exact:
+                return None
+            exec_row, exact = _resources_to_base(executor_resources)
+            if not exact:
+                return None
+            built = executor_reschedule_order(
+                snap,
+                list(node_names),
+                self._node_sorter.executor_label_priority,
+                zone,
+            )
+            if built is None:
+                return None
+            names, avail, overhead, res_entry = built
+            fit_avail = avail
+            if self._strict_reference_parity and len(names):
+                # QUIRK #1 (resource.go:638-643): nodes with a usage
+                # entry see overhead subtracted twice on this path
+                fit_avail = avail.copy()
+                fit_avail[res_entry] -= overhead[res_entry]
+            row = np.array(exec_row, dtype=np.int64)
+            fits = (fit_avail >= row[None, :]).all(axis=1)
+            hit = np.flatnonzero(fits)
+            self.last_reschedule_path = "fast"
+            if len(hit):
+                return True, names[int(hit[0])]
+            return False, None
+        except Exception:
+            logger.exception("fast reschedule lane failed; using Quantity path")
+            return None
 
     def _reschedule_executor_with_minimal_fragmentation(
         self,

@@ -60,6 +60,18 @@ class TensorSnapshot:
     unschedulable: np.ndarray        # [N] bool
     labels: List[Dict[str, str]]     # [N]
     exact: bool
+    # nodes referenced by ≥1 (hard or soft) reservation — i.e. nodes that
+    # would have an entry in GetReservedResources' usage map.  The
+    # reschedule path's double-overhead quirk (resource.go:638-643)
+    # applies only to such nodes, entry-ness included zero-valued
+    # reservations, so a resource-row test cannot stand in for it.
+    res_entries: np.ndarray          # [N] bool
+    # lexicographic rank of each node's name among live nodes — an int
+    # sort key equivalent to sorting the names themselves, maintained on
+    # topology changes so per-request orderings never sort object arrays
+    name_rank: np.ndarray            # [N] int64
+
+    _name_index: Optional[Dict[str, int]] = None
 
     @property
     def avail(self) -> np.ndarray:
@@ -68,6 +80,13 @@ class TensorSnapshot:
     @property
     def schedulable(self) -> np.ndarray:
         return self.allocatable - self.overhead
+
+    @property
+    def name_index(self) -> Dict[str, int]:
+        """name → row, built once per snapshot (C-speed dict(zip))."""
+        if self._name_index is None:
+            self._name_index = dict(zip(self.names, range(len(self.names))))
+        return self._name_index
 
 
 class TensorSnapshotCache:
@@ -81,6 +100,9 @@ class TensorSnapshotCache:
         self._free_nodes: List[int] = []
         self._alloc = np.zeros((0, 3), dtype=np.int64)
         self._usage = np.zeros((0, 3), dtype=np.int64)
+        self._res_count = np.zeros(0, dtype=np.int64)
+        self._name_rank = np.zeros(0, dtype=np.int64)
+        self._names_dirty = True
         self._node_overhead = np.zeros((0, 3), dtype=np.int64)
         self._zone_id = np.zeros(0, dtype=np.int32)
         self._ready = np.zeros(0, dtype=bool)
@@ -90,6 +112,7 @@ class TensorSnapshotCache:
         self._zone_index: Dict[str, int] = {}
         # usage destined for nodes we don't (yet) know
         self._orphan_usage: Dict[str, np.ndarray] = {}
+        self._orphan_res_count: Dict[str, int] = {}
 
         # pod table (for overhead)
         self._pod_slot: Dict[Tuple[str, str], int] = {}
@@ -136,6 +159,8 @@ class TensorSnapshotCache:
         extra = _GROW
         self._alloc = np.vstack([self._alloc, np.zeros((extra, 3), np.int64)])
         self._usage = np.vstack([self._usage, np.zeros((extra, 3), np.int64)])
+        self._res_count = np.concatenate([self._res_count, np.zeros(extra, np.int64)])
+        self._name_rank = np.concatenate([self._name_rank, np.zeros(extra, np.int64)])
         self._node_overhead = np.vstack(
             [self._node_overhead, np.zeros((extra, 3), np.int64)]
         )
@@ -154,8 +179,10 @@ class TensorSnapshotCache:
                 slot = self._free_nodes.pop() if self._free_nodes else self._grow_nodes()
                 self._node_slot[node.name] = slot
                 self._node_names[slot] = node.name
+                self._names_dirty = True
                 pending = self._orphan_usage.pop(node.name, None)
                 self._usage[slot] = pending if pending is not None else 0
+                self._res_count[slot] = self._orphan_res_count.pop(node.name, 0)
             row, exact = _resources_to_base(node.allocatable)
             if not exact:
                 self._exact = False
@@ -173,9 +200,13 @@ class TensorSnapshotCache:
             # park any remaining usage so a node re-add restores it
             if self._usage[slot].any():
                 self._orphan_usage[node.name] = self._usage[slot].copy()
+            if self._res_count[slot]:
+                self._orphan_res_count[node.name] = int(self._res_count[slot])
             self._node_names[slot] = None
+            self._names_dirty = True
             self._alloc[slot] = 0
             self._usage[slot] = 0
+            self._res_count[slot] = 0
             self._node_overhead[slot] = 0
             self._ready[slot] = False
             self._labels[slot] = {}
@@ -185,14 +216,23 @@ class TensorSnapshotCache:
     # -- reservation usage ---------------------------------------------------
 
     def _apply_usage(self, node: str, row: np.ndarray, sign: int) -> None:
+        # each call is one reservation contribution: the entry count
+        # tracks whether the node would appear in GetReservedResources'
+        # usage map at all (even with zero-valued rows)
+        # like the usage row, the count is NOT clamped: a transient
+        # minus-before-plus imbalance must cancel exactly when the
+        # matching event arrives, or entry-ness would desync from the
+        # reserved-resources map permanently
         slot = self._node_slot.get(node)
         if slot is not None:
             self._usage[slot] += sign * row
+            self._res_count[slot] += sign
         else:
             current = self._orphan_usage.get(node)
             if current is None:
                 current = np.zeros(3, np.int64)
             self._orphan_usage[node] = current + sign * row
+            self._orphan_res_count[node] = self._orphan_res_count.get(node, 0) + sign
 
     @staticmethod
     def _rr_rows(rr) -> Dict[str, np.ndarray]:
@@ -319,10 +359,19 @@ class TensorSnapshotCache:
         self._node_overhead = overhead
         self._pods_dirty = False
 
+    def _recompute_name_ranks(self) -> None:
+        live = [i for i, name in enumerate(self._node_names) if name is not None]
+        order = sorted(live, key=lambda i: self._node_names[i])
+        for rank, slot in enumerate(order):
+            self._name_rank[slot] = rank
+        self._names_dirty = False
+
     def snapshot(self) -> TensorSnapshot:
         with self._lock:
             if self._pods_dirty:
                 self._recompute_overhead()
+            if self._names_dirty:
+                self._recompute_name_ranks()
             live = [i for i, name in enumerate(self._node_names) if name is not None]
             idx = np.array(live, dtype=np.int64)
             if len(idx) == 0:
@@ -338,6 +387,11 @@ class TensorSnapshotCache:
                 zone_id=self._zone_id[idx].copy(),
                 ready=self._ready[idx].copy(),
                 unschedulable=self._unsched[idx].copy(),
-                labels=[dict(self._labels[i]) for i in live],
+                # label dicts are replaced (never mutated) on node events,
+                # so sharing the references is safe and skips 10k dict
+                # copies per request
+                labels=[self._labels[i] for i in live],
                 exact=self._exact,
+                res_entries=(self._res_count[idx] > 0).copy(),
+                name_rank=self._name_rank[idx].copy(),
             )
