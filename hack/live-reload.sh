@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Rebuild the extender image and restart the running deployment, then
+# tail the new pod's logs — the edit/compile/run loop for dev clusters
+# (analog of the reference's pod-restart reload script, but through a
+# rollout so the HA pair restarts cleanly one replica at a time).
+set -euo pipefail
+
+SCRIPT_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+NAMESPACE=kube-system
+NAME=tpu-gang-scheduler
+
+if [ "${SKIP_BUILD:-}" != "1" ]; then
+  eval "$(minikube docker-env)"
+  docker build -t "${NAME}:latest" -f "${SCRIPT_ROOT}/docker/Dockerfile" "${SCRIPT_ROOT}"
+fi
+
+kubectl -n "${NAMESPACE}" rollout restart "deploy/${NAME}"
+kubectl -n "${NAMESPACE}" rollout status "deploy/${NAME}" --timeout=180s
+
+POD="$(kubectl -n "${NAMESPACE}" get pods -l app="${NAME}" \
+  --field-selector=status.phase=Running \
+  -o jsonpath='{.items[0].metadata.name}')"
+echo "tailing logs from ${POD} (ctrl-c to stop)"
+exec kubectl -n "${NAMESPACE}" logs -f "${POD}"

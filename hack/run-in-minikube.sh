@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Build, deploy and run the extender on a running minikube cluster —
+# the dev-cluster e2e loop (analog of the reference's minikube tooling,
+# adapted to this framework's image/manifest shape).
+#
+#   minikube start            # once
+#   hack/run-in-minikube.sh   # build image in-cluster, certs, deploy
+#
+# Afterwards:
+#   examples/submit-test-spark-app.sh   # submit an annotated test app
+#   hack/live-reload.sh                 # rebuild + restart + tail logs
+set -euo pipefail
+
+SCRIPT_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+NAMESPACE=kube-system
+NAME=tpu-gang-scheduler
+CERT_DIR="${SCRIPT_ROOT}/out/certs"
+
+# 1. build the image inside minikube's docker daemon so the deployment
+#    can pull it without a registry (imagePullPolicy: IfNotPresent)
+eval "$(minikube docker-env)"
+docker build -t "${NAME}:latest" -f "${SCRIPT_ROOT}/docker/Dockerfile" "${SCRIPT_ROOT}"
+
+# 2. TLS: the apiserver only dials the CRD conversion webhook over HTTPS
+#    with a trusted caBundle; the kube-scheduler extender config also
+#    talks HTTPS
+"${SCRIPT_ROOT}/hack/generate-certs.sh" "${CERT_DIR}" "${NAME}" "${NAMESPACE}"
+
+kubectl -n "${NAMESPACE}" delete secret "${NAME}-tls" --ignore-not-found
+kubectl -n "${NAMESPACE}" create secret tls "${NAME}-tls" \
+  --cert="${CERT_DIR}/server.crt" --key="${CERT_DIR}/server.key"
+
+# 3. install config: ship examples/install.json (the file the
+#    deployment's --config points at) plus the CA so the server can
+#    stamp the conversion webhook's caBundle itself
+kubectl -n "${NAMESPACE}" delete configmap "${NAME}-config" --ignore-not-found
+kubectl -n "${NAMESPACE}" create configmap "${NAME}-config" \
+  --from-file=install.json="${SCRIPT_ROOT}/examples/install.json" \
+  --from-file=ca.crt="${CERT_DIR}/ca.crt"
+
+# 4. RBAC + service + deployment
+kubectl apply -f "${SCRIPT_ROOT}/examples/extender-deployment.yaml"
+kubectl -n "${NAMESPACE}" rollout status "deploy/${NAME}" --timeout=180s
+
+echo
+echo "extender is up:"
+kubectl -n "${NAMESPACE}" get pods -l app="${NAME}"
+echo
+echo "next: examples/submit-test-spark-app.sh to drive a gang decision,"
+echo "      hack/live-reload.sh after code changes"

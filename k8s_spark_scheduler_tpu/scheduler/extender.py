@@ -794,8 +794,6 @@ class SparkSchedulerExtender:
             from ..ops.tensorize import _resources_to_base
 
             snap = self._tensor_snapshot.snapshot()
-            if not snap.exact:
-                return None
             exec_row, exact = _resources_to_base(executor_resources)
             if not exact:
                 return None

@@ -392,6 +392,6 @@ class TensorSnapshotCache:
                 # copies per request
                 labels=[self._labels[i] for i in live],
                 exact=self._exact,
-                res_entries=(self._res_count[idx] > 0).copy(),
+                res_entries=self._res_count[idx] > 0,  # comparison allocates fresh
                 name_rank=self._name_rank[idx].copy(),
             )
