@@ -17,8 +17,8 @@ fi
 kubectl -n "${NAMESPACE}" rollout restart "deploy/${NAME}"
 kubectl -n "${NAMESPACE}" rollout status "deploy/${NAME}" --timeout=180s
 
-POD="$(kubectl -n "${NAMESPACE}" get pods -l app="${NAME}" \
-  --field-selector=status.phase=Running \
-  -o jsonpath='{.items[0].metadata.name}')"
-echo "tailing logs from ${POD} (ctrl-c to stop)"
-exec kubectl -n "${NAMESPACE}" logs -f "${POD}"
+# logs via the deployment so we always follow a CURRENT replica — a
+# pod selected by phase=Running right after rollout can still be the
+# terminating old one
+echo "tailing logs (ctrl-c to stop)"
+exec kubectl -n "${NAMESPACE}" logs -f "deploy/${NAME}"

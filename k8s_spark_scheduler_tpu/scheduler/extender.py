@@ -303,6 +303,10 @@ class SparkSchedulerExtender:
         fast = self._try_fast_driver_path(
             instance_group, driver, node_names, app_resources_early
         )
+        self._metrics.counter(
+            "foundry.spark.scheduler.tpu.fastpath",
+            {"path": "driver", "lane": "fast" if fast is not None else "slow"},
+        )
         if fast is not None:
             outcome, zones = fast
             if not outcome.earlier_ok:
@@ -516,7 +520,7 @@ class SparkSchedulerExtender:
             )
             skip_allowed.append(self._should_skip_driver_fifo(queued, instance_group))
         try:
-            return solver.solve(
+            outcome = solver.solve(
                 metadata,
                 driver_node_names,
                 executor_node_names,
@@ -528,6 +532,15 @@ class SparkSchedulerExtender:
                     app_resources.min_executor_count,
                 ),
             )
+            lane = getattr(solver, "last_path", None)
+            if lane is not None:
+                # single-AZ solvers report fused (one-dispatch) vs host
+                # (exact fallback) — the ops signal for how often the
+                # certified fixed-point zone choice holds
+                self._metrics.counter(
+                    "foundry.spark.scheduler.tpu.singleaz.lane", {"lane": lane}
+                )
+            return outcome
         except Exception:
             logger.exception("device FIFO solve failed; falling back to host loop")
             return None
@@ -696,6 +709,10 @@ class SparkSchedulerExtender:
             node_names,
             executor_resources,
             single_az_zone if should_schedule_into_single_az else None,
+        )
+        self._metrics.counter(
+            "foundry.spark.scheduler.tpu.fastpath",
+            {"path": "executor", "lane": "fast" if fast is not None else "slow"},
         )
         if fast is not None:
             hit, name = fast

@@ -283,6 +283,26 @@ def test_fast_reschedule_lane_engages_and_matches_slow_lane():
                     assert lanes["slow"] == "slow", tag
 
 
+def test_fastpath_lane_counters(harness):
+    """Lane-engagement observability: driver and executor Filter calls
+    record which lane served them."""
+    nodes = two_node_cluster(harness)
+    pods = harness.dynamic_allocation_spark_pods("app-metrics", 1, 3)
+    for p in pods:
+        harness.schedule(p, nodes)
+    reg = harness.server.extender._metrics
+    drv = sum(
+        reg.get_counter("foundry.spark.scheduler.tpu.fastpath", {"path": "driver", "lane": lane})
+        for lane in ("fast", "slow")
+    )
+    exe = sum(
+        reg.get_counter("foundry.spark.scheduler.tpu.fastpath", {"path": "executor", "lane": lane})
+        for lane in ("fast", "slow")
+    )
+    assert drv >= 1  # the driver Filter call
+    assert exe >= 2  # the extra executors beyond min took the reschedule path
+
+
 def test_dynamic_allocation_compaction_on_executor_death(harness):
     nodes = two_node_cluster(harness)
     pods = harness.dynamic_allocation_spark_pods("app-da", 1, 2)
