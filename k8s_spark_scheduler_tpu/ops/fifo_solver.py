@@ -37,6 +37,18 @@ def _ceil_div(v: int, d: int) -> int:
     return -((-v) // d)
 
 
+def _pallas_selected(backend: str) -> bool:
+    """Shared backend choice: 'pallas' forces the kernel, 'auto' uses it
+    exactly when the default backend is a TPU."""
+    if backend == "pallas":
+        return True
+    if backend == "auto":
+        import jax
+
+        return jax.default_backend() == "tpu"
+    return False
+
+
 def efficiencies_from_rows(names, sched_rows, avail_rows, reserved_rows):
     """compute_packing_efficiencies from exact base-unit int rows —
     bit-identical floats to the Quantity path (efficiency.go:80-105):
@@ -66,6 +78,16 @@ def efficiencies_from_rows(names, sched_rows, avail_rows, reserved_rows):
 
 
 @dataclass
+class FusedQueueOut:
+    """The slice of ZoneQueueSolve the fused single-AZ caller consumes
+    (shared shape between the XLA and pallas backends)."""
+
+    feasible: object
+    uncertain: object
+    avail_after: object
+
+
+@dataclass
 class FifoOutcome:
     """Result of the combined earlier-drivers + current-driver solve."""
 
@@ -89,13 +111,7 @@ class TpuFifoSolver:
         self.backend = backend
 
     def _use_pallas(self) -> bool:
-        if self.backend == "pallas":
-            return True
-        if self.backend == "auto":
-            import jax
-
-            return jax.default_backend() == "tpu"
-        return False
+        return _pallas_selected(self.backend)
 
     def solve(
         self,
@@ -225,8 +241,6 @@ def _fused_efficiency_inputs(cluster, problem):
     (r_base = sched_base − m·scale), f32 exactness of all ratio operands
     (ints ≤ 2^24), ratios ≤ 1 (avail ≤ schedulable), and an int32-safe
     score accumulator ((k+1)·2^EFF_SHIFT < 2^31)."""
-    import jax.numpy as jnp
-
     n = len(cluster.node_names)
     nb = problem.avail.shape[0]
     sched = cluster.sched[:n]  # int64 base units (milli-cpu, bytes, milli-gpu)
@@ -261,14 +275,7 @@ def _fused_efficiency_inputs(cluster, problem):
     inv_m[:n] = (float(scale[1]) / sched[:, 1].astype(np.float64)).astype(np.float32)
     th = np.zeros(nb, np.int32)
     th[:n] = th_mem
-    return (
-        jnp.asarray(s_cpu),
-        jnp.asarray(s_gpu),
-        jnp.asarray(inv_m),
-        jnp.asarray(th),
-        jnp.int32(int(scale[0])),
-        jnp.int32(int(scale[2])),
-    )
+    return s_cpu, s_gpu, inv_m, th, int(scale[0]), int(scale[2])
 
 
 class TpuSingleAzFifoSolver:
@@ -290,9 +297,18 @@ class TpuSingleAzFifoSolver:
     chosen with the exact host math.  `last_path` records which lane ran
     ("fused" / "host") for tests and diagnostics."""
 
-    def __init__(self, az_aware: bool = False):
+    def __init__(
+        self, az_aware: bool = False, backend: str = "auto", interpret: bool = False
+    ):
         self.az_aware = az_aware
+        self.backend = backend
+        # interpret=True runs the pallas kernel in interpreter mode so the
+        # solver-side pallas wiring is testable on CPU
+        self.interpret = interpret
         self.last_path: Optional[str] = None
+
+    def _use_pallas(self) -> bool:
+        return _pallas_selected(self.backend)
 
     def solve(
         self,
@@ -393,20 +409,61 @@ class TpuSingleAzFifoSolver:
         if n_earlier > 0:
             eff_inputs = _fused_efficiency_inputs(cluster, problem)
             if eff_inputs is not None:
+                s_cpu, s_gpu, inv_m, th_m, scale_c, scale_g = eff_inputs
                 queue_valid = problem.app_valid.copy()
                 queue_valid[n_earlier:] = False
-                out = solve_queue_single_az(
-                    jnp.asarray(avail),
-                    rank_dev,
-                    exec_dev,
-                    zone_masks_dev,
-                    jnp.asarray(problem.driver),
-                    jnp.asarray(problem.executor),
-                    jnp.asarray(problem.count),
-                    jnp.asarray(queue_valid),
-                    *eff_inputs,
-                    az_aware=self.az_aware,
-                )
+                if self._use_pallas():
+                    from .pallas_queue import pallas_solve_queue_single_az
+
+                    # disjoint zone masks → one zone index per node
+                    # (-1 = in no candidate zone)
+                    zone_vec = np.full(avail.shape[0], -1, np.int32)
+                    for zi in range(len(candidate_zones)):
+                        zone_vec[zone_masks[zi]] = zi
+                    feas_d, _zone_d, _didx_d, uncertain_d, avail_after_d = (
+                        pallas_solve_queue_single_az(
+                            jnp.asarray(avail),
+                            rank_dev,
+                            exec_dev,
+                            jnp.asarray(zone_vec),
+                            jnp.asarray(problem.driver),
+                            jnp.asarray(problem.executor),
+                            jnp.asarray(problem.count),
+                            jnp.asarray(queue_valid),
+                            jnp.asarray(s_cpu),
+                            jnp.asarray(s_gpu),
+                            jnp.asarray(inv_m),
+                            jnp.asarray(th_m),
+                            jnp.asarray(np.array([scale_c], np.int32)),
+                            jnp.asarray(np.array([scale_g], np.int32)),
+                            n_zones=len(candidate_zones),
+                            az_aware=self.az_aware,
+                            interpret=self.interpret,
+                        )
+                    )
+                    out = FusedQueueOut(
+                        feasible=feas_d,
+                        uncertain=uncertain_d,
+                        avail_after=avail_after_d,
+                    )
+                else:
+                    out = solve_queue_single_az(
+                        jnp.asarray(avail),
+                        rank_dev,
+                        exec_dev,
+                        zone_masks_dev,
+                        jnp.asarray(problem.driver),
+                        jnp.asarray(problem.executor),
+                        jnp.asarray(problem.count),
+                        jnp.asarray(queue_valid),
+                        jnp.asarray(s_cpu),
+                        jnp.asarray(s_gpu),
+                        jnp.asarray(inv_m),
+                        jnp.asarray(th_m),
+                        jnp.int32(scale_c),
+                        jnp.int32(scale_g),
+                        az_aware=self.az_aware,
+                    )
                 if not bool(np.asarray(out.uncertain)[:n_earlier].any()):
                     # the one-dispatch lane's answer is certain — it is
                     # the lane that served this request, whatever the

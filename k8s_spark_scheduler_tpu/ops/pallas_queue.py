@@ -31,6 +31,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .batch_solver import EFF_SHIFT
+
 LANES = 128
 BIG = 2**31 - 1  # plain int: a module-level jnp scalar would be a captured const in the kernel
 
@@ -176,6 +178,272 @@ def _queue_kernel(
         avail_out[...] = ac[...]
         availm_out[...] = am[...]
         availg_out[...] = ag[...]
+
+
+def _solve_tightly(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
+    """One tightly-pack gang solve on [R, 128] planes (the body shared
+    with _queue_kernel, zone-maskable via rank/exec_ok).  Returns
+    (feasible, flat_idx, is_driver, exec_counts)."""
+    rows, lanes = rank.shape
+
+    def caps(c, m, g):
+        def dim(avail_d, req):
+            return jnp.where(req == 0, BIG, lax.div(avail_d, jnp.maximum(req, 1)))
+
+        cap = jnp.minimum(jnp.minimum(dim(c, ex[0]), dim(m, ex[1])), dim(g, ex[2]))
+        return jnp.clip(cap, 0, k)
+
+    base_cap = jnp.where(exec_ok, caps(cpu, mem, gpu), 0)
+    cap_with_driver = jnp.where(
+        exec_ok, caps(cpu - dr[0], mem - dr[1], gpu - dr[2]), 0
+    )
+    driver_fits = (cpu >= dr[0]) & (mem >= dr[1]) & (gpu >= dr[2]) & (rank < BIG)
+    total = jnp.sum(base_cap)
+    total_d = total - base_cap + cap_with_driver
+    feasible_d = driver_fits & (total_d >= k)
+
+    masked_rank = jnp.where(feasible_d, rank, BIG)
+    best_rank = jnp.min(masked_rank)
+    feasible = best_rank < BIG
+    flat_idx = jnp.min(jnp.where(masked_rank == best_rank, node_ids, BIG))
+    is_driver = (node_ids == flat_idx) & feasible
+
+    cap = jnp.where(is_driver, cap_with_driver, base_cap)
+    cap = jnp.where(feasible, cap, 0)
+    cum_excl = _flat_cumsum_exclusive(cap)
+    x = jnp.clip(k - cum_excl, 0, cap)
+    x = jnp.where(feasible, x, 0)
+    return feasible, flat_idx, is_driver, x
+
+
+def _singleaz_kernel(
+    # scalar prefetch (SMEM)
+    dcpu, dmem, dgpu, ecpu, emem, egpu, ks, valids, scale_c_ref, scale_g_ref,
+    # VMEM planes
+    avail0, availm0, availg0, rank_ref, execok_ref, zone_ref,
+    scpu_ref, sgpu_ref, thm_ref, invm_ref,
+    # outputs
+    feas_ref, avail_out, availm_out, availg_out,
+    # scratch
+    ac, am, ag,
+    *,
+    n_zones: int,
+    az_aware: bool,
+    n_apps: int,
+):
+    """Whole single-AZ FIFO queue in one VMEM-resident kernel: the
+    pallas counterpart of batch_solver.solve_queue_single_az (same
+    decision semantics: per-zone tightly-pack, certified fixed-point
+    zone score at EFF_SHIFT=18, strict-improvement choice in zone
+    order, az-aware cross-zone fallback, subtraction quirk)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        ac[...] = avail0[...]
+        am[...] = availm0[...]
+        ag[...] = availg0[...]
+
+    rank = rank_ref[...]
+    exec_ok = execok_ref[...] != 0
+    zone_plane = zone_ref[...]
+    s_cpu = scpu_ref[...]
+    s_gpu = sgpu_ref[...]
+    th_m = thm_ref[...]
+    inv_m = invm_ref[...]
+    scale_c = scale_c_ref[0]
+    scale_g = scale_g_ref[0]
+    rows, lanes = rank.shape
+    row_ids = lax.broadcasted_iota(jnp.int32, (rows, lanes), 0)
+    lane_ids = lax.broadcasted_iota(jnp.int32, (rows, lanes), 1)
+    node_ids = row_ids * lanes + lane_ids
+    out_lanes = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
+    dr = jnp.array([dcpu[i], dmem[i], dgpu[i]], dtype=jnp.int32)
+    ex = jnp.array([ecpu[i], emem[i], egpu[i]], dtype=jnp.int32)
+    k = ks[i]
+    valid = valids[i]
+    band = 2 * (k + 1) + 2
+
+    cpu, mem, gpu = ac[...], am[...], ag[...]
+    den_c = jnp.maximum(lax.div(s_cpu + 999, jnp.int32(1000)), 1)
+    den_g = jnp.maximum(lax.div(s_gpu + 999, jnp.int32(1000)), 1)
+    has_gpu = s_gpu > 0
+
+    best_q = jnp.int32(0)
+    best_zone = jnp.int32(-1)
+    uncertain = jnp.int32(0)
+    # int32 planes (not bool): mosaic cannot legalize a select over i1
+    # vectors with a scalar predicate
+    chosen_exec = jnp.zeros((rows, lanes), jnp.int32)
+    chosen_driver = jnp.zeros((rows, lanes), jnp.int32)
+    chosen_idx = jnp.int32(rows * lanes)
+
+    def score(x, is_driver):
+        w = x + is_driver.astype(jnp.int32)
+        new_c = x * ex[0] + jnp.where(is_driver, dr[0], 0)
+        new_m = x * ex[1] + jnp.where(is_driver, dr[1], 0)
+        new_g = x * ex[2] + jnp.where(is_driver, dr[2], 0)
+        m_c = cpu - new_c
+        m_m = mem - new_m
+        m_g = gpu - new_g
+        num_cq = s_cpu - m_c * scale_c
+        num_gq = s_gpu - m_g * scale_g
+        num_cores = lax.div(num_cq + 999, jnp.int32(1000))
+        num_gcores = lax.div(num_gq + 999, jnp.int32(1000))
+        ratio_c = num_cores.astype(jnp.float32) / den_c.astype(jnp.float32)
+        ratio_g = jnp.where(
+            has_gpu, num_gcores.astype(jnp.float32) / den_g.astype(jnp.float32), 0.0
+        )
+        ratio_m = jnp.maximum(1.0 - m_m.astype(jnp.float32) * inv_m, 0.0)
+        eff = jnp.maximum(jnp.maximum(ratio_c, ratio_m), ratio_g)
+        q = jnp.floor(eff * jnp.float32(2**EFF_SHIFT) + 0.5).astype(jnp.int32)
+        q_sum = jnp.sum(jnp.where(w > 0, w * q, 0))
+        nz = jnp.any(
+            (w > 0) & ((num_cq > 0) | (m_m < th_m) | (has_gpu & (num_gq > 0)))
+        )
+        return q_sum, nz
+
+    for z in range(n_zones):
+        mask = zone_plane == z
+        f, flat_idx, is_driver, x = _solve_tightly(
+            cpu, mem, gpu,
+            jnp.where(mask, rank, BIG), exec_ok & mask, dr, ex, k, node_ids,
+        )
+        q_sum, nz = score(x, is_driver)
+        first = best_zone < 0
+        better = f & jnp.where(first, nz, q_sum > best_q)
+        uncertain = uncertain | (
+            f & (~first) & (q_sum != best_q) & (jnp.abs(q_sum - best_q) <= band)
+        ).astype(jnp.int32)
+        best_q = jnp.where(better, q_sum, best_q)
+        best_zone = jnp.where(better, jnp.int32(z), best_zone)
+        chosen_exec = jnp.where(better, (x > 0).astype(jnp.int32), chosen_exec)
+        chosen_driver = jnp.where(better, is_driver.astype(jnp.int32), chosen_driver)
+        chosen_idx = jnp.where(better, flat_idx, chosen_idx)
+
+    if az_aware:
+        f, flat_idx, is_driver, x = _solve_tightly(
+            cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids
+        )
+        use_cross = (best_zone < 0) & f
+        chosen_exec = jnp.where(use_cross, (x > 0).astype(jnp.int32), chosen_exec)
+        chosen_driver = jnp.where(use_cross, is_driver.astype(jnp.int32), chosen_driver)
+        chosen_idx = jnp.where(use_cross, flat_idx, chosen_idx)
+        best_zone = jnp.where(use_cross, jnp.int32(n_zones), best_zone)
+
+    placed = (best_zone >= 0) & (valid != 0)
+    exec_mask = (chosen_exec != 0) & placed
+    driver_mask = (chosen_driver != 0) & placed & ~exec_mask
+
+    ac[...] = cpu - jnp.where(exec_mask, ex[0], jnp.where(driver_mask, dr[0], 0))
+    am[...] = mem - jnp.where(exec_mask, ex[1], jnp.where(driver_mask, dr[1], 0))
+    ag[...] = gpu - jnp.where(exec_mask, ex[2], jnp.where(driver_mask, dr[2], 0))
+
+    idx_val = jnp.where(placed, chosen_idx, jnp.int32(rows * lanes))
+    zone_val = jnp.where(placed, best_zone, jnp.int32(-1))
+    out_row = jnp.where(
+        out_lanes == 0,
+        placed.astype(jnp.int32),
+        jnp.where(
+            out_lanes == 1,
+            idx_val,
+            jnp.where(
+                out_lanes == 2, zone_val, jnp.where(out_lanes == 3, uncertain, 0)
+            ),
+        ),
+    )
+    feas_ref[pl.ds(i % 8, 1), :] = out_row
+
+    @pl.when(i == n_apps - 1)
+    def _final():
+        avail_out[...] = ac[...]
+        availm_out[...] = am[...]
+        availg_out[...] = ag[...]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_zones", "az_aware", "interpret")
+)
+def pallas_solve_queue_single_az(
+    avail: jnp.ndarray,        # [N, 3] int32
+    driver_rank: jnp.ndarray,  # [N] int32
+    exec_ok: jnp.ndarray,      # [N] bool
+    zone_id: jnp.ndarray,      # [N] int32 (zone index; -1 = no candidate zone)
+    drivers: jnp.ndarray,      # [A, 3] int32
+    executors: jnp.ndarray,    # [A, 3] int32
+    counts: jnp.ndarray,       # [A] int32
+    app_valid: jnp.ndarray,    # [A] bool
+    s_cpu_milli: jnp.ndarray,  # [N] int32
+    s_gpu_milli: jnp.ndarray,  # [N] int32
+    inv_mem: jnp.ndarray,      # [N] f32
+    th_mem: jnp.ndarray,       # [N] int32
+    scale_cpu: jnp.ndarray,    # [1] int32
+    scale_gpu: jnp.ndarray,    # [1] int32
+    n_zones: int = 1,
+    az_aware: bool = False,
+    interpret: bool = False,
+):
+    """Single-kernel single-AZ FIFO solve.  Returns (feasible[A],
+    zone_idx[A], driver_idx[A], uncertain[A], avail_after[N, 3]) with
+    decisions identical to batch_solver.solve_queue_single_az
+    (tests/test_pallas_queue.py proves it on randomized queues)."""
+    n = avail.shape[0]
+    a = drivers.shape[0]
+    rows, padded = _row_layout(n)
+
+    def plane(v, fill=0, dtype=jnp.int32):
+        flat = jnp.full((padded,), fill, dtype=dtype)
+        flat = flat.at[:n].set(v.astype(dtype))
+        return flat.reshape(rows, LANES)
+
+    kernel = functools.partial(
+        _singleaz_kernel, n_zones=n_zones, az_aware=az_aware, n_apps=a
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=10,
+        grid=(a,),
+        in_specs=[pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0))] * 10,
+        out_specs=[
+            pl.BlockSpec((8, LANES), lambda i, *refs: (i // 8, 0)),
+            pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
+            pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
+            pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((rows, LANES), jnp.int32)] * 3,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((a, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+    ]
+    feas, c_out, m_out, g_out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        drivers[:, 0], drivers[:, 1], drivers[:, 2],
+        executors[:, 0], executors[:, 1], executors[:, 2],
+        counts, app_valid.astype(jnp.int32),
+        scale_cpu.astype(jnp.int32), scale_gpu.astype(jnp.int32),
+        plane(avail[:, 0]), plane(avail[:, 1]), plane(avail[:, 2]),
+        plane(driver_rank, fill=int(BIG)),
+        plane(exec_ok.astype(jnp.int32)),
+        plane(zone_id, fill=-1),
+        plane(s_cpu_milli), plane(s_gpu_milli),
+        plane(th_mem),
+        plane(inv_mem, fill=0, dtype=jnp.float32),
+    )
+    feasible = feas[:, 0] != 0
+    driver_idx = jnp.where(feasible, feas[:, 1], jnp.int32(n))
+    zone_idx = feas[:, 2]
+    uncertain = feas[:, 3] != 0
+    avail_after = jnp.stack(
+        [c_out.reshape(-1)[:n], m_out.reshape(-1)[:n], g_out.reshape(-1)[:n]], axis=1
+    )
+    return feasible, zone_idx, driver_idx, uncertain, avail_after
 
 
 @functools.partial(

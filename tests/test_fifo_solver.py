@@ -270,6 +270,41 @@ def test_single_az_fused_near_tie_falls_back_to_host():
 
 
 @pytest.mark.parametrize("az_aware", [False, True])
+def test_single_az_pallas_solver_wiring(az_aware):
+    """The solver's pallas branch (zone_vec build, [1]-shaped scale
+    arrays, FusedQueueOut adaptation) must produce the same outcomes as
+    the XLA branch — run in interpreter mode so the wiring is covered on
+    CPU, not just on TPU hardware."""
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import TpuSingleAzFifoSolver
+
+    rng = random.Random(5151 + az_aware)
+    compared = 0
+    for trial in range(4):
+        metadata = random_cluster(rng, rng.randint(3, 12))
+        driver_order, executor_order = orders_for(metadata, rng)
+        earlier = [random_app(rng) for _ in range(rng.randint(1, 5))]
+        skip_allowed = [rng.random() < 0.3 for _ in earlier]
+        current = random_app(rng)
+        args = (metadata, driver_order, executor_order, earlier, skip_allowed, current)
+
+        xla = TpuSingleAzFifoSolver(az_aware=az_aware, backend="xla")
+        ref = xla.solve(*args)
+        if xla.last_path != "fused":
+            continue
+        pal = TpuSingleAzFifoSolver(az_aware=az_aware, backend="pallas", interpret=True)
+        got = pal.solve(*args)
+        assert pal.last_path == "fused", f"trial {trial}"
+        compared += 1
+        assert got.earlier_ok == ref.earlier_ok, f"trial {trial}"
+        if ref.earlier_ok:
+            assert got.result.has_capacity == ref.result.has_capacity, f"trial {trial}"
+            if ref.result.has_capacity:
+                assert got.result.driver_node == ref.result.driver_node, f"trial {trial}"
+                assert got.result.executor_nodes == ref.result.executor_nodes, f"trial {trial}"
+    assert compared >= 2, f"only {compared}/4 trials exercised the pallas branch"
+
+
+@pytest.mark.parametrize("az_aware", [False, True])
 def test_single_az_fused_matches_forced_host_lane(az_aware, monkeypatch):
     """Differential: the fused one-dispatch lane and the per-driver host
     lane must agree on every decision for queues where the fused lane is

@@ -58,6 +58,84 @@ def test_pallas_matches_xla_scan(evenly, apps_per_step):
         assert (np.asarray(avail_after) == np.asarray(ref.avail_after)).all(), f"trial {trial}"
 
 
+@pytest.mark.parametrize("az_aware", [False, True])
+def test_pallas_single_az_matches_xla(az_aware):
+    """The single-kernel single-AZ queue solve must agree with the XLA
+    scan (solve_queue_single_az) on every output, including the
+    uncertainty flags and the carried availability."""
+    from k8s_spark_scheduler_tpu.ops.batch_adapter import candidate_zone_masks
+    from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue_single_az
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import _fused_efficiency_inputs
+    from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue_single_az
+
+    rng = random.Random(777 + az_aware)
+    compared = 0
+    for trial in range(8):
+        metadata = random_cluster(rng, rng.randint(2, 30))
+        apps = [random_app(rng) for _ in range(rng.randint(1, 16))]
+        driver_order, executor_order = orders_for(metadata, rng)
+        cluster = tensorize_cluster(metadata, driver_order, executor_order)
+        problem = scale_problem(cluster, tensorize_apps(apps))
+        if not problem.ok:
+            continue
+        eff = _fused_efficiency_inputs(cluster, problem)
+        if eff is None:
+            continue
+        s_cpu, s_gpu, inv_m, th_m, scale_c, scale_g = eff
+        nb = problem.avail.shape[0]
+        candidate_zones, zone_masks = candidate_zone_masks(
+            driver_order, executor_order, metadata, cluster.node_names, nb
+        )
+        ref = solve_queue_single_az(
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(zone_masks),
+            jnp.asarray(problem.driver),
+            jnp.asarray(problem.executor),
+            jnp.asarray(problem.count),
+            jnp.asarray(problem.app_valid),
+            jnp.asarray(s_cpu),
+            jnp.asarray(s_gpu),
+            jnp.asarray(inv_m),
+            jnp.asarray(th_m),
+            jnp.int32(scale_c),
+            jnp.int32(scale_g),
+            az_aware=az_aware,
+        )
+        zone_vec = np.full(nb, -1, np.int32)
+        for zi in range(len(candidate_zones)):
+            zone_vec[zone_masks[zi]] = zi
+        feas, zidx, didx, unc, avail_after = pallas_solve_queue_single_az(
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(zone_vec),
+            jnp.asarray(problem.driver),
+            jnp.asarray(problem.executor),
+            jnp.asarray(problem.count),
+            jnp.asarray(problem.app_valid),
+            jnp.asarray(s_cpu),
+            jnp.asarray(s_gpu),
+            jnp.asarray(inv_m),
+            jnp.asarray(th_m),
+            jnp.asarray(np.array([scale_c], np.int32)),
+            jnp.asarray(np.array([scale_g], np.int32)),
+            n_zones=len(candidate_zones),
+            az_aware=az_aware,
+            interpret=True,
+        )
+        compared += 1
+        tag = f"trial {trial}"
+        assert (np.asarray(feas) == np.asarray(ref.feasible)).all(), tag
+        if candidate_zones:  # cross-zone marker value differs when Z == 0
+            assert (np.asarray(zidx) == np.asarray(ref.zone_idx)).all(), tag
+        assert (np.asarray(didx) == np.asarray(ref.driver_idx)).all(), tag
+        assert (np.asarray(unc) == np.asarray(ref.uncertain)).all(), tag
+        assert (np.asarray(avail_after) == np.asarray(ref.avail_after)).all(), tag
+    assert compared >= 5, f"only {compared}/8 trials were comparable"
+
+
 def test_pallas_empty_and_infeasible():
     # all-infeasible queue must leave availability untouched
     metadata = {
