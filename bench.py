@@ -264,7 +264,70 @@ def tpu_worker() -> int:
         extra=f" apps_per_step={aps}",
         as_worker=True,
     )
+    _single_az_diag(problem, rtt_s)
     return 0
+
+
+def _single_az_diag(problem, rtt_s: float) -> None:
+    """Secondary diagnostic: the single-AZ whole-queue kernel
+    (pallas_solve_queue_single_az) on the same snapshot with a synthetic
+    3-zone split — the single-AZ policies' FIFO cost (stderr only)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_spark_scheduler_tpu.ops.pallas_queue import (
+            pallas_solve_queue_single_az,
+        )
+
+        nb = problem.avail.shape[0]
+        zone_vec = (np.arange(nb) % 3).astype(np.int32)
+        sched = np.full(nb, 96000, np.int32)  # uniform synthetic schedulables
+        no_gpu = np.zeros(nb, np.int32)
+        inv_m = np.full(nb, 1.0 / 256.0, np.float32)
+        th_m = np.full(nb, 256, np.int32)
+        rest = (
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(zone_vec),
+            jnp.asarray(problem.driver),
+            jnp.asarray(problem.executor),
+            jnp.asarray(problem.count),
+            jnp.asarray(problem.app_valid),
+            jnp.asarray(sched),
+            jnp.asarray(no_gpu),
+            jnp.asarray(inv_m),
+            jnp.asarray(th_m),
+            jnp.asarray(np.array([1000], np.int32)),
+            jnp.asarray(np.array([1000], np.int32)),
+        )
+
+        diag_chain = 4
+
+        @functools.partial(jax.jit, static_argnames=("chain",))
+        def chained(a, chain=diag_chain):
+            tot = jnp.int32(0)
+            for _ in range(chain):
+                feas, _z, _d, unc, a2 = pallas_solve_queue_single_az(
+                    a, *rest, n_zones=3, az_aware=True
+                )
+                tot = tot + jnp.sum(feas) + jnp.sum(unc)
+                a = a2
+            return tot
+        a0 = jnp.asarray(problem.avail)
+        int(chained(a0))  # compile
+        lat = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            int(chained(a0))
+            lat.append(max(time.perf_counter() - t0 - rtt_s, 0.0) / diag_chain * 1000.0)
+        print(
+            f"# single-az az-aware whole-queue (pallas, 3 zones): "
+            f"median={float(np.median(lat)):.1f}ms/queue",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        print(f"# single-az diagnostic failed: {err}", file=sys.stderr)
 
 
 def _run_tpu_worker_attempt(timeout_s: float) -> dict | None | str:

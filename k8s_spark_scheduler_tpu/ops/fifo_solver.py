@@ -78,16 +78,6 @@ def efficiencies_from_rows(names, sched_rows, avail_rows, reserved_rows):
 
 
 @dataclass
-class FusedQueueOut:
-    """The slice of ZoneQueueSolve the fused single-AZ caller consumes
-    (shared shape between the XLA and pallas backends)."""
-
-    feasible: object
-    uncertain: object
-    avail_after: object
-
-
-@dataclass
 class FifoOutcome:
     """Result of the combined earlier-drivers + current-driver solve."""
 
@@ -417,10 +407,12 @@ class TpuSingleAzFifoSolver:
 
                     # disjoint zone masks → one zone index per node
                     # (-1 = in no candidate zone)
+                    from .batch_solver import ZoneQueueSolve
+
                     zone_vec = np.full(avail.shape[0], -1, np.int32)
                     for zi in range(len(candidate_zones)):
                         zone_vec[zone_masks[zi]] = zi
-                    feas_d, _zone_d, _didx_d, uncertain_d, avail_after_d = (
+                    feas_d, zone_d, didx_d, uncertain_d, avail_after_d = (
                         pallas_solve_queue_single_az(
                             jnp.asarray(avail),
                             rank_dev,
@@ -441,8 +433,10 @@ class TpuSingleAzFifoSolver:
                             interpret=self.interpret,
                         )
                     )
-                    out = FusedQueueOut(
+                    out = ZoneQueueSolve(
                         feasible=feas_d,
+                        zone_idx=zone_d,
+                        driver_idx=didx_d,
                         uncertain=uncertain_d,
                         avail_after=avail_after_d,
                     )

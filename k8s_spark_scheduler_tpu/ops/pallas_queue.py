@@ -117,34 +117,12 @@ def _queue_kernel(
 
         cpu, mem, gpu = ac[...], am[...], ag[...]
 
-        def caps(c, m, g, ex=ex, k=k):
-            def dim(avail_d, req):
-                return jnp.where(req == 0, BIG, lax.div(avail_d, jnp.maximum(req, 1)))
-
-            cap = jnp.minimum(jnp.minimum(dim(c, ex[0]), dim(m, ex[1])), dim(g, ex[2]))
-            return jnp.clip(cap, 0, k)
-
-        base_cap = jnp.where(exec_ok, caps(cpu, mem, gpu), 0)
-        cap_with_driver = jnp.where(
-            exec_ok, caps(cpu - dr[0], mem - dr[1], gpu - dr[2]), 0
+        feasible0, flat_idx, is_driver0, cap0 = _gang_core(
+            cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids
         )
-
-        driver_fits = (cpu >= dr[0]) & (mem >= dr[1]) & (gpu >= dr[2]) & (rank < BIG)
-        total = jnp.sum(base_cap)
-        total_d = total - base_cap + cap_with_driver
-        feasible_d = driver_fits & (total_d >= k)
-
-        masked_rank = jnp.where(feasible_d, rank, BIG)
-        best_rank = jnp.min(masked_rank)
-        feasible = (best_rank < BIG) & (valid != 0)
-
-        # ranks are unique, so the min-rank node is unique when feasible
-        # (mosaic has no int argmin: recover the index via a masked min)
-        flat_idx = jnp.min(jnp.where(masked_rank == best_rank, node_ids, BIG))
-        is_driver = (node_ids == flat_idx) & feasible
-
-        cap = jnp.where(is_driver, cap_with_driver, base_cap)
-        cap = jnp.where(feasible, cap, 0)
+        feasible = feasible0 & (valid != 0)
+        is_driver = is_driver0 & feasible
+        cap = jnp.where(feasible, cap0, 0)
 
         if evenly:
             has = (cap > 0).astype(jnp.int32)
@@ -180,11 +158,11 @@ def _queue_kernel(
         availg_out[...] = ag[...]
 
 
-def _solve_tightly(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
-    """One tightly-pack gang solve on [R, 128] planes (the body shared
-    with _queue_kernel, zone-maskable via rank/exec_ok).  Returns
-    (feasible, flat_idx, is_driver, exec_counts)."""
-    rows, lanes = rank.shape
+def _gang_core(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
+    """The shared gang-solve core on [R, 128] planes (zone-maskable via
+    rank/exec_ok), used by both queue kernels: driver selection by the
+    capacity-total identity.  Returns (feasible, flat_idx, is_driver,
+    cap) with cap already driver-adjusted and zeroed when infeasible."""
 
     def caps(c, m, g):
         def dim(avail_d, req):
@@ -210,6 +188,15 @@ def _solve_tightly(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
 
     cap = jnp.where(is_driver, cap_with_driver, base_cap)
     cap = jnp.where(feasible, cap, 0)
+    return feasible, flat_idx, is_driver, cap
+
+
+def _solve_tightly(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
+    """_gang_core + the tightly-pack greedy fill.  Returns (feasible,
+    flat_idx, is_driver, exec_counts)."""
+    feasible, flat_idx, is_driver, cap = _gang_core(
+        cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids
+    )
     cum_excl = _flat_cumsum_exclusive(cap)
     x = jnp.clip(k - cum_excl, 0, cap)
     x = jnp.where(feasible, x, 0)
