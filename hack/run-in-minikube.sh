@@ -38,9 +38,12 @@ kubectl -n "${NAMESPACE}" create configmap "${NAME}-config" \
   --from-file=install.json="${SCRIPT_ROOT}/examples/install.json" \
   --from-file=ca.crt="${CERT_DIR}/ca.crt"
 
-# 4. RBAC + service + deployment
+# 4. RBAC + service + deployment, then the spark-scheduler
+#    kube-scheduler pair that calls the extender for Filter
 kubectl apply -f "${SCRIPT_ROOT}/examples/extender-deployment.yaml"
 kubectl -n "${NAMESPACE}" rollout status "deploy/${NAME}" --timeout=180s
+kubectl apply -f "${SCRIPT_ROOT}/examples/spark-kube-scheduler.yaml"
+kubectl -n "${NAMESPACE}" rollout status deploy/spark-kube-scheduler --timeout=180s
 
 echo
 echo "extender is up:"

@@ -46,11 +46,17 @@ def sync_resource_reservations_and_demands(extender) -> None:
     pods = extender._pod_lister.list()
     nodes = extender._node_informer.list()
     rrs = extender._resource_reservations.list()
-    overhead = extender._overhead.get_overhead(nodes)
-    soft_overhead = extender._soft_reservation_store.used_soft_reservation_resources()
-    available, ordered_nodes = _available_resources_per_instance_group(
-        extender._instance_group_label, rrs, nodes, overhead, soft_overhead
-    )
+    fast = _available_resources_fast(extender, nodes)
+    if fast is not None:
+        available, ordered_nodes = fast
+    else:
+        overhead = extender._overhead.get_overhead(nodes)
+        soft_overhead = (
+            extender._soft_reservation_store.used_soft_reservation_resources()
+        )
+        available, ordered_nodes = _available_resources_per_instance_group(
+            extender._instance_group_label, rrs, nodes, overhead, soft_overhead
+        )
     stale = _unreserved_spark_pods_by_spark_id(rrs, extender._soft_reservation_store, pods)
     logger.info("starting reconciliation for %d stale apps", len(stale))
 
@@ -110,6 +116,89 @@ def _is_not_scheduled_spark_pod(pod: Pod) -> bool:
     )
 
 
+class _LazyNodeGroupResources(dict):
+    """NodeGroupResources materialized on demand from exact integer
+    availability rows.  Reconciliation touches only the handful of nodes
+    the greedy filler probes, so constructing 3 Quantities for every
+    node in a 10k-node snapshot up front (the dominant reconcile cost)
+    is wasted work; reads through [] / .get build entries lazily and
+    writes behave like a plain dict."""
+
+    def __init__(self, rows_by_name):
+        super().__init__()
+        self._rows = rows_by_name  # name → int64 base-unit row
+
+    def __missing__(self, name):
+        row = self._rows[name]  # KeyError for unknown nodes, like the eager map
+        res = _resources_from_base_row(row)
+        self[name] = res
+        return res
+
+    def get(self, name, default=None):
+        try:
+            return self[name]
+        except KeyError:
+            return default
+
+
+def _resources_from_base_row(row) -> Resources:
+    from fractions import Fraction
+
+    from ..utils.quantity import Quantity
+
+    return Resources(
+        Quantity(Fraction(int(row[0]), 1000)),
+        Quantity(int(row[1])),
+        Quantity(Fraction(int(row[2]), 1000)),
+    )
+
+
+def _schedulable_nodes_by_group(
+    instance_group_label: str, nodes: List[Node]
+) -> Dict[str, List[Node]]:
+    """failover.go:286-323's node-eligibility step, shared by both
+    availability lanes: ready schedulable nodes grouped by instance
+    group, newest first."""
+    ordered = sorted(nodes, key=lambda n: n.creation_timestamp, reverse=True)
+    schedulable: Dict[str, List[Node]] = {}
+    for n in ordered:
+        if n.unschedulable or not n.ready:
+            continue
+        group = n.labels.get(instance_group_label, "")
+        schedulable.setdefault(group, []).append(n)
+    return schedulable
+
+
+def _available_resources_fast(extender, nodes: List[Node]):
+    """The reconcile availability map served from the tensor mirror:
+    identical values to _available_resources_per_instance_group
+    (mirror avail = allocatable − reservations − overhead − soft, proven
+    by tests/test_tensor_snapshot.py), with per-node Resources built
+    only when the reconciler actually reads them.  Returns None when the
+    mirror fast paths are disabled (_fast_path_ok, the same kill switch
+    the extender's other mirror lanes honor), the mirror is absent or
+    inexact, or it is out of step with the informer."""
+    cache = getattr(extender, "_tensor_snapshot", None)
+    if cache is None or not getattr(extender, "_fast_path_ok", False):
+        return None
+    snap = cache.snapshot()
+    if not snap.exact:
+        return None
+    index = snap.name_index
+    rows = snap.avail
+    schedulable = _schedulable_nodes_by_group(extender._instance_group_label, nodes)
+    available = {}
+    for group, ns in schedulable.items():
+        group_rows = {}
+        for n in ns:
+            i = index.get(n.name)
+            if i is None:
+                return None  # informer/mirror drift: take the exact path
+            group_rows[n.name] = rows[i]
+        available[group] = _LazyNodeGroupResources(group_rows)
+    return available, schedulable
+
+
 def _available_resources_per_instance_group(
     instance_group_label: str,
     rrs,
@@ -120,13 +209,7 @@ def _available_resources_per_instance_group(
     """failover.go:286-323: ready schedulable nodes grouped by instance
     group (newest first), availability = allocatable − RRs − overhead −
     soft usage."""
-    nodes = sorted(nodes, key=lambda n: n.creation_timestamp, reverse=True)
-    schedulable: Dict[str, List[Node]] = {}
-    for n in nodes:
-        if n.unschedulable or not n.ready:
-            continue
-        group = n.labels.get(instance_group_label, "")
-        schedulable.setdefault(group, []).append(n)
+    schedulable = _schedulable_nodes_by_group(instance_group_label, nodes)
 
     usages = usage_for_nodes(rrs)
     group_add(usages, overhead)

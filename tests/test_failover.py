@@ -116,6 +116,57 @@ def test_reconcile_deletes_demands_of_scheduled_pods(harness):
     assert harness.wait_for_api(lambda: len(harness.api.list("Demand")) == 0)
 
 
+def test_reconcile_fast_availability_matches_slow(monkeypatch):
+    """The mirror-served availability lane must reconstruct exactly the
+    same reservations as the Quantity path, including the greedy
+    filler's no-refund quirk (it mutates the availability map).  The
+    stale app binds only its driver + 1 of 4 executors, so _find_nodes
+    must probe availability for the remaining 3 — the rows the fast lane
+    decodes lazily."""
+    import k8s_spark_scheduler_tpu.scheduler.failover as fo
+
+    results = {}
+    decoded = {"n": 0}
+    real_decode = fo._resources_from_base_row
+
+    def counting_decode(row):
+        decoded["n"] += 1
+        return real_decode(row)
+
+    for lane in ("fast", "slow"):
+        h = Harness()
+        try:
+            for i in range(6):
+                h.new_node(f"n{i}", cpu="8", memory="8Gi")
+            nodes = [f"n{i}" for i in range(6)]
+            # driver + 1 executor bound; min_executor_count is 4, so the
+            # reconciler's greedy filler must reserve 3 more slots
+            pods = h.static_allocation_spark_pods("app-lost", 4)
+            for i, pod in enumerate(pods[:2]):
+                pod.node_name = nodes[i]
+                pod.phase = PodPhase.RUNNING
+                h.create_pod(pod)
+            with monkeypatch.context() as m:
+                m.setattr(fo, "_resources_from_base_row", counting_decode)
+                if lane == "slow":
+                    m.setattr(fo, "_available_resources_fast", lambda *a, **k: None)
+                before = decoded["n"]
+                sync_resource_reservations_and_demands(h.server.extender)
+                if lane == "fast":
+                    assert decoded["n"] > before, "fast lane never decoded a row"
+            rrs = {
+                rr.name: sorted(
+                    (name, res.node) for name, res in rr.spec.reservations.items()
+                )
+                for rr in h.server.resource_reservation_cache.list()
+            }
+            assert rrs, "reconcile must have rebuilt the lost RR"
+            results[lane] = rrs
+        finally:
+            h.close()
+    assert results["fast"] == results["slow"], results
+
+
 def test_reconcile_triggered_after_idle(harness, monkeypatch):
     """resource.go:194-205: first predicate after >15s idle reconciles."""
     harness.new_node("n1")
