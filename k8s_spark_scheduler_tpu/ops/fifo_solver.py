@@ -49,32 +49,97 @@ def _pallas_selected(backend: str) -> bool:
     return False
 
 
+class LazyEfficiencies(dict):
+    """Per-node PackingEfficiency mapping backed by vectorized float64
+    columns.  The zone choice reads only the placement nodes' entries
+    and the metrics path needs only the average of per-node maxes, so
+    building 10k dataclasses per Filter request (the dominant host cost
+    of the driver fast lane) is deferred: [] / .get materialize single
+    entries; values()/items() materialize everything (only the exact
+    Quantity-parity consumers do that)."""
+
+    def __init__(self, names, cpu, mem, gpu):
+        super().__init__()
+        self._names = list(names)
+        self._col_idx = dict(zip(self._names, range(len(self._names))))
+        self._cpu = cpu
+        self._mem = mem
+        self._gpu = gpu
+
+    def __missing__(self, name):
+        from .efficiency import PackingEfficiency
+
+        i = self._col_idx[name]
+        e = PackingEfficiency(
+            node_name=name,
+            cpu=float(self._cpu[i]),
+            memory=float(self._mem[i]),
+            gpu=float(self._gpu[i]),
+        )
+        self[name] = e
+        return e
+
+    def get(self, name, default=None):
+        try:
+            return self[name]
+        except KeyError:
+            return default
+
+    # the full dict read protocol must reflect ALL nodes (not just the
+    # materialized subset), and iteration must stay in node order so
+    # order-sensitive float accumulations (compute_avg_packing_
+    # efficiency) see exactly the sequence the eager dict produced
+    def __iter__(self):
+        return iter(self._names)
+
+    def __len__(self):
+        return len(self._names)
+
+    def __contains__(self, name):
+        return name in self._col_idx
+
+    def keys(self):
+        return list(self._names)
+
+    def values(self):
+        return [self[n] for n in self._names]
+
+    def items(self):
+        return [(n, self[n]) for n in self._names]
+
+    def seq_max_avg(self) -> float:
+        """sum(max(gpu, cpu, memory)) / n with the same float64
+        sequential-sum semantics as iterating the dict values (the
+        extender's packing-efficiency gauge)."""
+        if not self._names:
+            return 0.0
+        maxes = np.maximum(np.maximum(self._cpu, self._mem), self._gpu)
+        return sum(maxes.tolist()) / float(len(self._names))
+
+
 def efficiencies_from_rows(names, sched_rows, avail_rows, reserved_rows):
     """compute_packing_efficiencies from exact base-unit int rows —
     bit-identical floats to the Quantity path (efficiency.go:80-105):
     per-dim reserved = schedulable − available + newly_reserved, then
-    Quantity.value() semantics (ceil to canonical units) and ratio."""
-    from .efficiency import PackingEfficiency
-
-    out = {}
-    for i, name in enumerate(names):
-        s_cpu = _ceil_div(int(sched_rows[i, 0]), 1000)
-        s_mem = int(sched_rows[i, 1])
-        s_gpu = _ceil_div(int(sched_rows[i, 2]), 1000)
-        r = sched_rows[i] - avail_rows[i] + reserved_rows[i]
-        r_cpu = _ceil_div(int(r[0]), 1000)
-        r_mem = int(r[1])
-        r_gpu = _ceil_div(int(r[2]), 1000)
-        gpu_eff = 0.0
-        if s_gpu != 0:
-            gpu_eff = float(r_gpu) / float(s_gpu if s_gpu != 0 else 1)
-        out[name] = PackingEfficiency(
-            node_name=name,
-            cpu=float(r_cpu) / float(s_cpu if s_cpu != 0 else 1),
-            memory=float(r_mem) / float(s_mem if s_mem != 0 else 1),
-            gpu=gpu_eff,
-        )
-    return out
+    Quantity.value() semantics (ceil to canonical units) and ratio —
+    computed as vectorized int64/float64 columns (identical IEEE results
+    to the scalar loop) behind a lazily-materialized mapping."""
+    n = len(names)
+    s = np.asarray(sched_rows)[:n].astype(np.int64)
+    r = (
+        s
+        - np.asarray(avail_rows)[:n].astype(np.int64)
+        + np.asarray(reserved_rows)[:n].astype(np.int64)
+    )
+    s_cpu = _ceil_div(s[:, 0], 1000)
+    s_gpu = _ceil_div(s[:, 2], 1000)
+    r_cpu = _ceil_div(r[:, 0], 1000)
+    r_gpu = _ceil_div(r[:, 2], 1000)
+    # Go divides by normalize(schedulable)=1 when schedulable is 0
+    cpu = r_cpu / np.maximum(s_cpu, 1)
+    mem = r[:, 1] / np.maximum(s[:, 1], 1)
+    gpu = np.where(s_gpu != 0, r_gpu / np.maximum(s_gpu, 1), 0.0)
+    return LazyEfficiencies(names, cpu, mem, gpu)
 
 
 @dataclass
@@ -219,6 +284,11 @@ class TpuFifoSolver:
             executor_nodes=executor_nodes,
             has_capacity=True,
             packing_efficiencies=efficiencies,
+            max_avg_efficiency=(
+                efficiencies.seq_max_avg()
+                if isinstance(efficiencies, LazyEfficiencies)
+                else None
+            ),
         )
         return FifoOutcome(supported=True, earlier_ok=True, result=result)
 

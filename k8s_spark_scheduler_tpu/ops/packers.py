@@ -41,6 +41,11 @@ class PackingResult:
     executor_nodes: List[str] = field(default_factory=list)
     packing_efficiencies: Dict[str, PackingEfficiency] = field(default_factory=dict)
     has_capacity: bool = False
+    # set by the tensor fast lanes: avg of per-node max efficiencies with
+    # the same float64 value the metrics path would compute by iterating
+    # packing_efficiencies — lets the gauge skip materializing 10k lazy
+    # entries per request
+    max_avg_efficiency: Optional[float] = None
 
 
 def empty_packing_result() -> PackingResult:

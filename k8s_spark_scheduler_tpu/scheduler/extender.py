@@ -392,11 +392,17 @@ class SparkSchedulerExtender:
             raise SchedulingFailure(FAILURE_FIT, "application does not fit to the cluster")
 
         if efficiency is None:
-            # fast path: average the per-node efficiencies directly (the
-            # device adapters compute them with exact value() semantics)
-            effs = list(packing_result.packing_efficiencies.values())
-            max_sum = sum(max(e.gpu, e.cpu, e.memory) for e in effs)
-            max_avg = max_sum / max(len(effs), 1)
+            if packing_result.max_avg_efficiency is not None:
+                # precomputed by the tensor lanes (same float64 value as
+                # the iteration below, without materializing every node)
+                max_avg = packing_result.max_avg_efficiency
+            else:
+                # fast path: average the per-node efficiencies directly
+                # (the device adapters compute them with exact value()
+                # semantics)
+                effs = list(packing_result.packing_efficiencies.values())
+                max_sum = sum(max(e.gpu, e.cpu, e.memory) for e in effs)
+                max_avg = max_sum / max(len(effs), 1)
         else:
             max_avg = efficiency.max
         self._metrics.gauge(

@@ -85,6 +85,58 @@ def test_fifo_solver_parity_random():
                 )
 
 
+def test_lazy_efficiencies_match_scalar_reference():
+    """The vectorized efficiency columns must be bit-identical to the
+    scalar value()/ratio computation (efficiency.go:80-105 semantics),
+    and seq_max_avg must equal the metric path's sequential iteration."""
+    import numpy as np
+
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import efficiencies_from_rows
+
+    rng = np.random.RandomState(99)
+    n = 200
+    names = [f"n{i:03d}" for i in range(n)]
+    sched = np.stack([
+        rng.randint(0, 96001, n), rng.randint(0, 2**34, n), rng.randint(0, 8001, n),
+    ], axis=1).astype(np.int64)
+    avail = (sched * rng.uniform(0, 1, (n, 3))).astype(np.int64)
+    reserved = ((sched - avail) * rng.uniform(0, 1, (n, 3))).astype(np.int64)
+
+    lazy = efficiencies_from_rows(names, sched, avail, reserved)
+
+    def ceil_div(v, d):
+        return -((-int(v)) // d)
+
+    maxes = []
+    for i, name in enumerate(names):
+        s_cpu = ceil_div(sched[i, 0], 1000)
+        s_gpu = ceil_div(sched[i, 2], 1000)
+        r = sched[i] - avail[i] + reserved[i]
+        r_cpu = ceil_div(r[0], 1000)
+        r_gpu = ceil_div(r[2], 1000)
+        want_cpu = float(r_cpu) / float(s_cpu if s_cpu != 0 else 1)
+        want_mem = float(int(r[1])) / float(int(sched[i, 1]) if sched[i, 1] != 0 else 1)
+        want_gpu = 0.0 if s_gpu == 0 else float(r_gpu) / float(s_gpu)
+        e = lazy[name]
+        assert e.cpu == want_cpu and e.memory == want_mem and e.gpu == want_gpu, name
+        maxes.append(max(want_gpu, want_cpu, want_mem))
+    # builtin sum, like the extender's metric path (CPython 3.12's float
+    # sum() is Neumaier-compensated — a manual += loop differs by ulps)
+    assert lazy.seq_max_avg() == sum(maxes) / max(len(maxes), 1)
+
+    # the full dict read protocol reflects all nodes, in node order,
+    # regardless of which entries were materialized first
+    partial = efficiencies_from_rows(names, sched, avail, reserved)
+    _ = partial[names[57]]  # materialize one mid-list entry
+    assert len(partial) == n and names[3] in partial and "nope" not in partial
+    assert list(partial) == names and partial.keys() == names
+    assert [e.node_name for e in partial.values()] == names
+    assert [k for k, _v in partial.items()] == names
+    assert set(partial) == set(names)
+    assert partial.get("nope") is None
+    assert bool(partial)
+
+
 def test_extender_tpu_batch_fifo_end_to_end():
     h = Harness(binpack_algo="tpu-batch", is_fifo=True)
     try:
