@@ -246,7 +246,7 @@ class TpuBatchBinpacker:
             exec_row = problem.executor[0].astype(np.int64)
             per_dim = np.where(
                 exec_row[None, :] == 0,
-                np.int64(2**62),
+                np.where(avail >= 0, np.int64(2**62), np.int64(0)),
                 np.floor_divide(avail, np.maximum(exec_row[None, :], 1)),
             )
             cap = np.clip(per_dim.min(axis=1), 0, None)

@@ -54,11 +54,14 @@ class AppSolve(NamedTuple):
 
 def node_capacity(avail: jnp.ndarray, executor: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
     """Per-node executor capacity clamped to [0, k]
-    (capacity.go:36-75: floor division per dim, zero-requirement → ∞)."""
+    (capacity.go:36-75: floor division per dim, zero-requirement → ∞ —
+    but a dimension whose availability is already negative is 0 even
+    when the requirement is 0: reserved(0) > available short-circuits
+    before the zero-requirement check, capacity.go:37-44)."""
     safe = jnp.maximum(executor, 1)
     per_dim = jnp.where(
         executor[None, :] == 0,
-        BIG,
+        jnp.where(avail >= 0, BIG, 0),
         jnp.floor_divide(avail, safe[None, :]),
     )
     cap = jnp.min(per_dim, axis=1)

@@ -166,7 +166,10 @@ def _gang_core(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
 
     def caps(c, m, g):
         def dim(avail_d, req):
-            return jnp.where(req == 0, BIG, lax.div(avail_d, jnp.maximum(req, 1)))
+            # zero-requirement → ∞ unless the dimension is already
+            # negative (reserved(0) > available → 0, capacity.go:37-44)
+            unbounded = jnp.where(avail_d >= 0, BIG, 0)
+            return jnp.where(req == 0, unbounded, lax.div(avail_d, jnp.maximum(req, 1)))
 
         cap = jnp.minimum(jnp.minimum(dim(c, ex[0]), dim(m, ex[1])), dim(g, ex[2]))
         return jnp.clip(cap, 0, k)

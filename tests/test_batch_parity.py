@@ -41,6 +41,12 @@ def random_cluster(rng, n_nodes, fractional=False):
             cpu = str(rng.randint(1, 64))
             mem = f"{rng.randint(1, 64)}Gi"
         gpu = str(rng.choice([0, 0, 0, 1, 4, 8]))
+        # overbooked nodes: overhead can drive availability negative
+        # (alloc − usage − overhead, resources.go:61-100 has no floor)
+        if rng.random() < 0.06:
+            cpu = str(-rng.randint(1, 8))
+        if rng.random() < 0.04:
+            mem = f"-{rng.randint(1, 8)}Gi"
         md = NodeSchedulingMetadata(
             available=Resources.of(cpu, mem, gpu),
             schedulable=Resources.of("64", "64Gi", "8"),
@@ -60,8 +66,8 @@ def random_app(rng, gpu_prob=0.2):
             "1" if rng.random() < gpu_prob else "0",
         ),
         executor_resources=Resources.of(
-            rng.choice(["1", "2", "4", "500m"]),
-            rng.choice(["1Gi", "2Gi", "4Gi"]),
+            rng.choice(["1", "2", "4", "500m", "0"]),
+            rng.choice(["1Gi", "2Gi", "4Gi", "0"]),
             "1" if rng.random() < gpu_prob else "0",
         ),
         min_executor_count=rng.randint(0, 40),
@@ -386,3 +392,68 @@ def test_min_frag_device_parity_random():
         if expected.has_capacity:
             assert actual.driver_node == expected.driver_node, f"trial {trial}"
             assert actual.executor_nodes == expected.executor_nodes, f"trial {trial}"
+
+
+def test_negative_availability_zero_requirement_dim():
+    """A node whose availability has gone negative in one dimension has
+    zero capacity there even when the executor requires 0 of that
+    dimension: capacity.go:37-44's reserved(0) > available check
+    short-circuits before the zero-requirement → ∞ branch.  Regression:
+    the device capacity kernels used to grant ∞ and place executors on
+    the overbooked node."""
+    from fractions import Fraction
+
+    from k8s_spark_scheduler_tpu.utils.quantity import Quantity
+
+    def res(cpu_m, mem, gpu_m=0):
+        return Resources(
+            Quantity(Fraction(cpu_m, 1000)), Quantity(mem), Quantity(Fraction(gpu_m, 1000))
+        )
+
+    metadata = {
+        # n0: cpu overbooked (negative), plenty of memory
+        "n0": NodeSchedulingMetadata(
+            available=res(-1000, 8 << 30), schedulable=res(64000, 64 << 30), zone_label="z",
+        ),
+        "n1": NodeSchedulingMetadata(
+            available=res(4000, 1 << 30), schedulable=res(64000, 64 << 30), zone_label="z",
+        ),
+    }
+    order = ["n1", "n0"]
+    driver = res(1000, 1 << 29)
+    execu = res(0, 1 << 30)  # zero cpu requirement — the corner
+
+    for policy, oracle in [
+        ("tightly-pack", packers.tightly_pack),
+        ("distribute-evenly", packers.distribute_evenly),
+        ("minimal-fragmentation", packers.minimal_fragmentation_pack),
+    ]:
+        expected = oracle(driver, execu, 4, order, order, copy_metadata(metadata))
+        actual = TpuBatchBinpacker(assignment_policy=policy)(
+            driver, execu, 4, order, order, copy_metadata(metadata)
+        )
+        assert not expected.has_capacity, policy  # n0 unusable, n1 too small
+        assert actual.has_capacity == expected.has_capacity, policy
+
+    # the pallas queue kernel shares the fix (interpret mode)
+    from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue
+    from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
+
+    cluster = tensorize_cluster(metadata, order, order)
+    apps = tensorize_apps([AppDemand(driver, execu, 4)])
+    problem = scale_problem(cluster, apps)
+    assert problem.ok
+    import jax.numpy as jnp
+
+    feasible, _, _ = pallas_solve_queue(
+        jnp.asarray(problem.avail),
+        jnp.asarray(problem.driver_rank),
+        jnp.asarray(problem.exec_ok),
+        jnp.asarray(problem.driver),
+        jnp.asarray(problem.executor),
+        jnp.asarray(problem.count),
+        jnp.asarray(problem.app_valid),
+        evenly=False,
+        interpret=True,
+    )
+    assert not bool(np.asarray(feasible)[0])
