@@ -84,6 +84,26 @@ def build_reserved(
     return reserved
 
 
+def min_frag_unclamped_caps(
+    avail: np.ndarray, exec_row: np.ndarray, exec_ok: np.ndarray, driver_idx: int,
+    driver_row: np.ndarray,
+) -> np.ndarray:
+    """Exact UNCLAMPED per-node capacities (int64) for the min-frag
+    decode, from scaled integer availability rows with the driver
+    subtracted on its node (capacity.go:36-75; negative dims are 0 even
+    under a zero requirement — the reserved>available short-circuit)."""
+    avail = avail.astype(np.int64).copy()
+    avail[driver_idx] -= driver_row.astype(np.int64)
+    exec_row = exec_row.astype(np.int64)
+    per_dim = np.where(
+        exec_row[None, :] == 0,
+        np.where(avail >= 0, np.int64(2**62), np.int64(0)),
+        np.floor_divide(avail, np.maximum(exec_row[None, :], 1)),
+    )
+    cap = np.clip(per_dim.min(axis=1), 0, None)
+    return np.where(exec_ok, cap, 0)
+
+
 def minimal_fragmentation_assignment(
     names: List[str], cap: np.ndarray, k: int
 ) -> Optional[List[str]]:
@@ -241,16 +261,13 @@ class TpuBatchBinpacker:
             # capacities (the device clamps to k for overflow safety):
             # recompute exactly from the scaled integer rows, with the
             # driver subtracted on its node
-            avail = problem.avail[: len(names)].astype(np.int64).copy()
-            avail[driver_idx] -= problem.driver[0].astype(np.int64)
-            exec_row = problem.executor[0].astype(np.int64)
-            per_dim = np.where(
-                exec_row[None, :] == 0,
-                np.where(avail >= 0, np.int64(2**62), np.int64(0)),
-                np.floor_divide(avail, np.maximum(exec_row[None, :], 1)),
+            cap = min_frag_unclamped_caps(
+                problem.avail[: len(names)],
+                problem.executor[0],
+                np.asarray(problem.exec_ok[: len(names)]),
+                driver_idx,
+                problem.driver[0],
             )
-            cap = np.clip(per_dim.min(axis=1), 0, None)
-            cap = np.where(np.asarray(problem.exec_ok[: len(names)]), cap, 0)
             executor_nodes = minimal_fragmentation_assignment(names, cap, executor_count)
             if executor_nodes is None:
                 return empty_packing_result()
@@ -318,6 +335,8 @@ def tpu_batch_binpacker() -> Binpacker:
 def tpu_batch_min_frag_binpacker(
     strict_reference_parity: bool = compat.DEFAULT_STRICT,
 ) -> Binpacker:
+    from .fifo_solver import TpuFifoSolver
+
     return Binpacker(
         name="tpu-batch-minimal-fragmentation",
         binpack_func=TpuBatchBinpacker(
@@ -325,6 +344,10 @@ def tpu_batch_min_frag_binpacker(
             strict_reference_parity=strict_reference_parity,
         ),
         is_single_az=False,
+        queue_solver=TpuFifoSolver(
+            assignment_policy="minimal-fragmentation",
+            strict_reference_parity=strict_reference_parity,
+        ),
     )
 
 

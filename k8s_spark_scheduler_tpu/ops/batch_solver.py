@@ -219,6 +219,146 @@ def solve_queue(
     )
 
 
+# Unbounded-capacity stand-in for the min-frag kernel (host uses
+# 2^63-1, capacity.go:45-48).  Capacities here must stay UNCLAMPED for
+# the (k+max)/2 subset threshold, so the sentinel lives just above any
+# real capacity: callers guard max(avail) ≤ 2^31-3 (tensorize's GCD
+# scaling makes this essentially always true) so a real capacity can
+# never collide with it.
+MF_SENT = 2**31 - 2
+
+
+def min_frag_capacity(
+    avail: jnp.ndarray, executor: jnp.ndarray, exec_ok: jnp.ndarray
+) -> jnp.ndarray:
+    """UNCLAMPED per-node executor capacity (capacity.go:36-75) for the
+    minimal-fragmentation kernel; MF_SENT marks unbounded nodes."""
+    safe = jnp.maximum(executor, 1)
+    per_dim = jnp.where(
+        executor[None, :] == 0,
+        jnp.where(avail >= 0, MF_SENT, 0),
+        jnp.floor_divide(avail, safe[None, :]),
+    )
+    cap = jnp.min(per_dim, axis=1)
+    return jnp.where(exec_ok, jnp.clip(cap, 0, MF_SENT), 0)
+
+
+def min_frag_counts(cap: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Minimal-fragmentation per-node executor counts from unclamped
+    capacities — the whole of minimal_fragmentation.go:59-137 as O(N log N)
+    vector ops, no data-dependent loop.
+
+    The drain loop linearizes: sorting capacities descending (ties by
+    executor priority), a node is fully drained iff its capacity is
+    strictly below what remains when it becomes the max
+    (d_j < k − Σ_{i<j} d_i); the first position where that fails is the
+    final step, and the remaining k* executors go to the smallest
+    remaining capacity ≥ k* (earliest priority among equals) — exactly
+    the bisect the host runs.  The (k+max)/2 "avoid mostly-empty nodes"
+    subset attempt (minimal_fragmentation.go:71-87) is the same
+    computation under a tighter eligibility mask, so both runs share one
+    sort.  Only valid when Σ min(cap, k) ≥ k (the caller's solve_app
+    feasibility); returns zeros otherwise and for k = 0."""
+    n = cap.shape[0]
+    elig = cap > 0
+    iota = jnp.arange(n, dtype=jnp.int32)
+    # sort key: capacity descending, original (priority) index ascending;
+    # ineligible nodes get a positive key so they sort after all eligible
+    neg = jnp.where(elig, -cap, 1)
+    srt_neg, srt_idx = lax.sort((neg, iota), num_keys=2)
+    d = jnp.where(srt_neg < 0, -srt_neg, 0)
+    selig = srt_neg < 0
+    pos = jnp.arange(n, dtype=jnp.int32)
+
+    def run(sub):
+        """One _internal_minimal_fragmentation pass over the eligibility
+        mask `sub` (in sorted space).  Returns (ok, counts-by-node)."""
+        dd = jnp.where(sub, d, 0)
+        prefix = jnp.cumsum(dd) - dd  # exclusive; exact while k_j > 0
+        kj = k - prefix
+        stop = sub & (d >= kj) & (kj > 0)
+        ok = jnp.any(stop)
+        jstar = jnp.argmax(stop).astype(jnp.int32)
+        kstar = jnp.maximum(k - prefix[jstar], 0)
+        drained = sub & (pos < jstar)
+        # final placement: smallest capacity ≥ k* among the not-drained,
+        # ties to the earliest priority index (the ascending bisect)
+        cand = sub & (pos >= jstar) & (d >= kstar)
+        mincap = jnp.min(jnp.where(cand, d, BIG))
+        partial = jnp.min(jnp.where(cand & (d == mincap), srt_idx, jnp.int32(n)))
+        counts = jnp.zeros((n,), jnp.int32).at[srt_idx].set(jnp.where(drained, dd, 0))
+        partial_safe = jnp.minimum(partial, n - 1)
+        counts = counts.at[partial_safe].add(jnp.where(ok, kstar, 0))
+        return ok, counts
+
+    max_cap = jnp.max(jnp.where(selig, d, 0))
+    has_sent = jnp.any(selig & (d == MF_SENT))
+    # exact (k + max)//2 without int32 overflow; with an unbounded node
+    # the host threshold (k + 2^63-1)//2 admits every bounded capacity
+    target = (k // 2) + (max_cap // 2) + (((k & 1) + (max_cap & 1)) // 2)
+    subset = selig & jnp.where(has_sent, d < MF_SENT, d < target)
+    attempt = has_sent | (k < max_cap)
+    sub_ok, sub_counts = run(subset & attempt)
+    full_ok, full_counts = run(selig)
+    counts = jnp.where(attempt & sub_ok, sub_counts, full_counts)
+    return jnp.where(full_ok & (k > 0), counts, jnp.zeros_like(counts))
+
+
+@functools.partial(jax.jit, static_argnames=("with_placements",))
+def solve_queue_min_frag(
+    avail: jnp.ndarray,      # [N, 3] int32
+    driver_rank: jnp.ndarray,  # [N] int32
+    exec_ok: jnp.ndarray,    # [N]
+    drivers: jnp.ndarray,    # [A, 3] int32
+    executors: jnp.ndarray,  # [A, 3] int32
+    counts: jnp.ndarray,     # [A] int32
+    app_valid: jnp.ndarray,  # [A] bool
+    with_placements: bool = True,
+) -> QueueSolve:
+    """Whole-FIFO-queue solve under the minimal-fragmentation policy in
+    ONE dispatch (minimal_fragmentation.go:59-137 × resource.go:224-262).
+    Feasibility and driver choice equal tightly-pack's (the drain is
+    work-conserving, so distribution succeeds iff Σ capacity ≥ k); only
+    the placement — and therefore the carried usage subtraction — needs
+    the min-frag kernel."""
+    n = avail.shape[0]
+
+    def step(carry_avail, app):
+        driver, executor, k, valid = app
+        solve = solve_app(carry_avail, driver_rank, exec_ok, driver, executor, k)
+        feasible = solve.feasible & valid
+        didx = jnp.where(feasible, solve.driver_idx, jnp.int32(n))
+        is_drv = jnp.arange(n, dtype=jnp.int32) == didx
+        avail_eff = carry_avail - jnp.where(is_drv[:, None], driver[None, :], 0)
+        mf = min_frag_counts(min_frag_capacity(avail_eff, executor, exec_ok), k)
+        mf = jnp.where(feasible, mf, jnp.zeros_like(mf))
+        mf_solve = AppSolve(
+            feasible=feasible, driver_idx=didx, exec_counts=mf, exec_capacity=mf
+        )
+        delta = usage_delta(mf_solve, driver, executor, n, evenly=False)
+        out = (feasible, didx, mf) if with_placements else (feasible, didx)
+        return carry_avail - delta, out
+
+    avail_after, outs = lax.scan(step, avail, (drivers, executors, counts, app_valid))
+    if with_placements:
+        feasible, didx, mf = outs
+        return QueueSolve(
+            feasible=feasible,
+            driver_idx=didx,
+            exec_counts=mf,
+            exec_capacity=jnp.zeros((0,), jnp.int32),
+            avail_after=avail_after,
+        )
+    feasible, didx = outs
+    return QueueSolve(
+        feasible=feasible,
+        driver_idx=didx,
+        exec_counts=jnp.zeros((0,), jnp.int32),
+        exec_capacity=jnp.zeros((0,), jnp.int32),
+        avail_after=avail_after,
+    )
+
+
 @jax.jit
 def solve_single(
     avail: jnp.ndarray,

@@ -16,6 +16,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import compat
 from ..types.resources import NodeGroupSchedulingMetadata
 from .batch_adapter import (
     build_reserved,
@@ -23,6 +24,8 @@ from .batch_adapter import (
     counts_to_evenly_list,
     counts_to_tightly_list,
     evenly_counts,
+    min_frag_unclamped_caps,
+    minimal_fragmentation_assignment,
 )
 from .efficiency import compute_packing_efficiencies
 from .packers import PackingResult, empty_packing_result
@@ -161,9 +164,17 @@ class TpuFifoSolver:
     pay exactly the benched cost (queue pass + one O(N) decode solve for
     the current driver's placements)."""
 
-    def __init__(self, assignment_policy: str = "tightly-pack", backend: str = "auto"):
+    def __init__(
+        self,
+        assignment_policy: str = "tightly-pack",
+        backend: str = "auto",
+        strict_reference_parity: bool = compat.DEFAULT_STRICT,
+    ):
         self.assignment_policy = assignment_policy
         self.backend = backend
+        # min-frag only: whether the reference's no-efficiency-write-back
+        # quirk applies to the current driver's reported efficiencies
+        self.strict_reference_parity = strict_reference_parity
 
     def _use_pallas(self) -> bool:
         return _pallas_selected(self.backend)
@@ -195,7 +206,7 @@ class TpuFifoSolver:
         Quantity-based efficiency computation when provided)."""
         import jax.numpy as jnp
 
-        from .batch_solver import solve_queue, solve_single
+        from .batch_solver import solve_queue, solve_queue_min_frag, solve_single
 
         apps = tensorize_apps(list(earlier_apps) + [current_app])
         problem = scale_problem(cluster, apps)
@@ -203,6 +214,11 @@ class TpuFifoSolver:
             return FifoOutcome(supported=False)
 
         evenly = self.assignment_policy == "distribute-evenly"
+        minfrag = self.assignment_policy == "minimal-fragmentation"
+        if minfrag and problem.avail.size and int(problem.avail.max()) > 2**31 - 3:
+            # a real capacity could collide with the device kernel's
+            # unbounded-capacity sentinel (batch_solver.MF_SENT)
+            return FifoOutcome(supported=False)
         n_earlier = len(earlier_apps)
 
         if n_earlier > 0:
@@ -218,7 +234,11 @@ class TpuFifoSolver:
                 jnp.asarray(problem.count),
                 jnp.asarray(queue_valid),
             )
-            if self._use_pallas():
+            if minfrag:
+                out = solve_queue_min_frag(*queue_args, with_placements=False)
+                feasible = np.asarray(out.feasible)[:n_earlier]
+                avail_after = out.avail_after
+            elif self._use_pallas():
                 from .pallas_queue import pallas_solve_queue
 
                 feasible_dev, _, avail_after = pallas_solve_queue(
@@ -255,6 +275,27 @@ class TpuFifoSolver:
             cap = np.asarray(solve.exec_capacity)[: len(names)]
             counts = evenly_counts(cap, k)
             executor_nodes = counts_to_evenly_list(names, counts)
+        elif minfrag:
+            cap = min_frag_unclamped_caps(
+                np.asarray(avail_after)[: len(names)],
+                problem.executor[n_earlier],
+                np.asarray(problem.exec_ok[: len(names)]),
+                int(solve.driver_idx),
+                problem.driver[n_earlier],
+            )
+            executor_nodes = minimal_fragmentation_assignment(names, cap, k)
+            if executor_nodes is None:  # unreachable: feasibility proven above
+                return FifoOutcome(
+                    supported=True, earlier_ok=True, result=empty_packing_result()
+                )
+            # reference quirk: min-frag reports only the driver in
+            # reserved/efficiencies under strict parity (packers.
+            # make_minimal_fragmentation QUIRK, switchable)
+            counts = np.zeros(len(names), dtype=np.int64)
+            if not self.strict_reference_parity:
+                pos = {name: i for i, name in enumerate(names)}
+                for node in executor_nodes:
+                    counts[pos[node]] += 1
         else:
             counts = np.asarray(solve.exec_counts)[: len(names)]
             executor_nodes = counts_to_tightly_list(names, counts)

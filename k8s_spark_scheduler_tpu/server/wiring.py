@@ -88,9 +88,16 @@ class Server:
             try:
                 import jax.numpy as jnp
 
-                from ..ops.batch_solver import solve_queue, solve_single
+                from ..ops.batch_solver import (
+                    solve_queue,
+                    solve_queue_min_frag,
+                    solve_single,
+                )
                 from ..ops.tensorize import APP_BUCKETS, NODE_BUCKETS
 
+                minfrag = self.extender.binpacker.name.endswith(
+                    "minimal-fragmentation"
+                )
                 for nb in NODE_BUCKETS[:3]:  # the shapes real clusters hit first
                     if self._warm_stop.is_set():
                         return
@@ -101,7 +108,9 @@ class Server:
                     solve_single(avail, rank, eok, row, row, jnp.int32(0))
                     # the FIFO path's first-called kernel (smallest app bucket)
                     ab = APP_BUCKETS[0]
-                    solve_queue(
+                    queue_fn = solve_queue_min_frag if minfrag else solve_queue
+                    queue_kwargs = {} if minfrag else {"evenly": False}
+                    queue_fn(
                         avail,
                         rank,
                         eok,
@@ -109,8 +118,8 @@ class Server:
                         jnp.zeros((ab, 3), jnp.int32),
                         jnp.zeros((ab,), jnp.int32),
                         jnp.zeros((ab,), bool),
-                        evenly=False,
                         with_placements=False,
+                        **queue_kwargs,
                     )
             except Exception:
                 import logging

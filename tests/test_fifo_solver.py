@@ -19,11 +19,15 @@ from k8s_spark_scheduler_tpu.types.resources import (
 from test_batch_parity import orders_for, random_app, random_cluster
 
 
-def host_fifo_oracle(metadata, driver_order, executor_order, earlier, skip_allowed, current):
+def host_fifo_oracle(
+    metadata, driver_order, executor_order, earlier, skip_allowed, current,
+    packer=None,
+):
     """The reference's fitEarlierDrivers + final pack, on the oracles."""
+    packer = packer or packers.tightly_pack
     meta = copy_metadata(metadata)
     for app, skippable in zip(earlier, skip_allowed):
-        result = packers.tightly_pack(
+        result = packer(
             app.driver_resources,
             app.executor_resources,
             app.min_executor_count,
@@ -44,7 +48,7 @@ def host_fifo_oracle(metadata, driver_order, executor_order, earlier, skip_allow
                 result.executor_nodes,
             ),
         )
-    return True, packers.tightly_pack(
+    return True, packer(
         current.driver_resources,
         current.executor_resources,
         current.min_executor_count,
@@ -390,3 +394,120 @@ def test_single_az_fused_matches_forced_host_lane(az_aware, monkeypatch):
             if fused.result.has_capacity:
                 assert fused.result.driver_node == host.result.driver_node, f"trial {trial}"
                 assert fused.result.executor_nodes == host.result.executor_nodes, f"trial {trial}"
+
+
+def test_min_frag_counts_kernel_differential():
+    """The device min-frag kernel (sort + prefix-sum linearization of the
+    drain loop) must reproduce minimal_fragmentation_from_capacities
+    count-for-count, including capacity ties, unbounded sentinels, the
+    (k+max)/2 subset attempt, k=0, and infeasible totals."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_spark_scheduler_tpu.ops.batch_solver import MF_SENT, min_frag_counts
+    from k8s_spark_scheduler_tpu.ops.capacity import (
+        MAX_CAPACITY,
+        NodeAndExecutorCapacity,
+    )
+    from k8s_spark_scheduler_tpu.ops.packers import (
+        minimal_fragmentation_from_capacities,
+    )
+
+    rng = random.Random(4242)
+    mf_jit = jax.jit(min_frag_counts)
+    for trial in range(400):
+        n = rng.randint(1, 24)
+        caps = []
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.1:
+                caps.append(0)
+            elif r < 0.2:
+                caps.append(MF_SENT)  # unbounded (all-dims-zero requirement)
+            elif r < 0.5:
+                caps.append(rng.choice([1, 2, 3, 4, 5, 5, 8, 8]))  # dense ties
+            else:
+                caps.append(rng.randint(1, 60))
+        k = rng.choice([0, 1, rng.randint(1, 30), rng.randint(1, 200)])
+
+        host_caps = [
+            NodeAndExecutorCapacity(f"n{i}", MAX_CAPACITY if c == MF_SENT else c)
+            for i, c in enumerate(caps)
+            if c > 0
+        ]
+        expected, ok = ([], True) if k == 0 else minimal_fragmentation_from_capacities(
+            k, host_caps
+        )
+        dev = np.asarray(mf_jit(jnp.asarray(np.array(caps, np.int32)), jnp.int32(k)))
+        exp_counts = np.zeros(n, np.int64)
+        if ok and expected:
+            for name in expected:
+                exp_counts[int(name[1:])] += 1
+        if ok:
+            assert np.array_equal(dev[:n], exp_counts), (
+                f"trial {trial}: k={k} caps={caps} host={exp_counts.tolist()} "
+                f"dev={dev[:n].tolist()}"
+            )
+        else:
+            assert not dev[:n].any(), f"trial {trial}: nonzero counts on infeasible"
+
+
+def test_min_frag_fifo_solver_parity_random():
+    """Whole-queue min-frag scan vs the extender host loop on the min-frag
+    oracle (fused FIFO pass = one dispatch, VERDICT round-1 known gap)."""
+    rng = random.Random(52525)
+    solver = TpuFifoSolver(assignment_policy="minimal-fragmentation")
+    for trial in range(25):
+        metadata = random_cluster(rng, rng.randint(2, 20))
+        driver_order, executor_order = orders_for(metadata, rng)
+        earlier = [random_app(rng) for _ in range(rng.randint(0, 8))]
+        skip_allowed = [rng.random() < 0.3 for _ in earlier]
+        current = random_app(rng)
+
+        expected_ok, expected_result = host_fifo_oracle(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current,
+            packer=packers.minimal_fragmentation_pack,
+        )
+        outcome = solver.solve(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        assert outcome.supported
+        assert outcome.earlier_ok == expected_ok, f"trial {trial}: earlier_ok"
+        if expected_ok:
+            assert outcome.result.has_capacity == expected_result.has_capacity, (
+                f"trial {trial}: current feasibility"
+            )
+            if expected_result.has_capacity:
+                assert outcome.result.driver_node == expected_result.driver_node, (
+                    f"trial {trial}: driver node"
+                )
+                assert (
+                    outcome.result.executor_nodes == expected_result.executor_nodes
+                ), f"trial {trial}: placement"
+
+
+def test_extender_tpu_batch_min_frag_matches_host():
+    """tpu-batch-minimal-fragmentation through the full extender (FIFO on)
+    must decide identically to the host minimal-fragmentation policy."""
+    results = {}
+    for algo in ("minimal-fragmentation", "tpu-batch-minimal-fragmentation"):
+        h = Harness(binpack_algo=algo, is_fifo=True)
+        try:
+            h.new_node("n1", cpu="6", memory="6Gi")
+            h.new_node("n2", cpu="10", memory="10Gi")
+            h.new_node("n3", cpu="4", memory="4Gi")
+            nodes = ["n1", "n2", "n3"]
+            log = []
+            for app, execs in [("a", 3), ("b", 7), ("c", 2), ("d", 9)]:
+                pods = h.static_allocation_spark_pods(f"app-{app}", execs)
+                r = h.schedule(pods[0], nodes)
+                log.append((f"driver-{app}", tuple(r.node_names or [])))
+                if r.node_names:
+                    for p in pods[1:]:
+                        er = h.schedule(p, nodes)
+                        log.append((p.name, tuple(er.node_names or [])))
+            results[algo] = log
+        finally:
+            h.close()
+    assert results["minimal-fragmentation"] == results["tpu-batch-minimal-fragmentation"]
