@@ -332,6 +332,17 @@ def tpu_batch_binpacker() -> Binpacker:
     )
 
 
+def tpu_batch_evenly_binpacker() -> Binpacker:
+    from .fifo_solver import TpuFifoSolver
+
+    return Binpacker(
+        name="tpu-batch-distribute-evenly",
+        binpack_func=TpuBatchBinpacker(assignment_policy="distribute-evenly"),
+        is_single_az=False,
+        queue_solver=TpuFifoSolver(assignment_policy="distribute-evenly"),
+    )
+
+
 def tpu_batch_min_frag_binpacker(
     strict_reference_parity: bool = compat.DEFAULT_STRICT,
 ) -> Binpacker:

@@ -145,6 +145,31 @@ def efficiencies_from_rows(names, sched_rows, avail_rows, reserved_rows):
     return LazyEfficiencies(names, cpu, mem, gpu)
 
 
+def _patch_available(metadata, names, avail_rows):
+    """Metadata view whose candidate-node availability is replaced by the
+    post-queue scan carry (exact base-unit ints → exact Quantities):
+    host-lane parity for efficiency metrics, which the reference computes
+    against the metadata mutated by fitEarlierDrivers
+    (resource.go:255-259)."""
+    from dataclasses import replace
+    from fractions import Fraction
+
+    from ..types.resources import Resources
+    from ..utils.quantity import Quantity
+
+    patched = dict(metadata)
+    for i, name in enumerate(names):
+        patched[name] = replace(
+            metadata[name],
+            available=Resources(
+                Quantity(Fraction(int(avail_rows[i, 0]), 1000)),
+                Quantity(int(avail_rows[i, 1])),
+                Quantity(Fraction(int(avail_rows[i, 2]), 1000)),
+            ),
+        )
+    return patched
+
+
 @dataclass
 class FifoOutcome:
     """Result of the combined earlier-drivers + current-driver solve."""
@@ -301,13 +326,33 @@ class TpuFifoSolver:
             executor_nodes = counts_to_tightly_list(names, counts)
 
         # efficiencies feed metrics only on this path (non-single-AZ
-        # policies); computed vs the original snapshot like the oracle
+        # policies); the host lane computes them against the metadata
+        # MUTATED by the earlier-drivers pass (resource.go:255-259 then
+        # binpack on the same map), so both branches use the post-queue
+        # availability carried out of the device scan.  Domain contract:
+        # the rows branch averages over cluster.node_names, which the
+        # production caller (build_cluster_tensor) populates with EVERY
+        # affinity-matching node — the same domain as the host lane's
+        # metadata — not just schedulable candidates.
+        def post_queue_avail_rows():
+            if n_earlier == 0:
+                # no queue pass ran: skip the device→host sync + multiply
+                return cluster.avail[: len(names)]
+            scale = problem.scale.astype(np.int64)
+            return (
+                np.asarray(avail_after)[: len(names)].astype(np.int64)
+                * scale[None, :]
+            )
+
         if metadata is not None:
             reserved = build_reserved(
                 names, counts, driver_node, current_app.driver_resources,
                 current_app.executor_resources,
             )
-            efficiencies = compute_packing_efficiencies(metadata, reserved)
+            eff_meta = metadata
+            if n_earlier > 0:
+                eff_meta = _patch_available(metadata, names, post_queue_avail_rows())
+            efficiencies = compute_packing_efficiencies(eff_meta, reserved)
         else:
             # per-node reserved = count × executor (+ driver on its node)
             reserved_rows = np.zeros_like(cluster.avail)
@@ -318,7 +363,7 @@ class TpuFifoSolver:
                 counts.astype(np.int64)[:, None] * np.array(exec_row, np.int64)[None, :]
             )
             efficiencies = efficiencies_from_rows(
-                names, cluster.sched, cluster.avail, reserved_rows
+                names, cluster.sched, post_queue_avail_rows(), reserved_rows
             )
         result = PackingResult(
             driver_node=driver_node,

@@ -24,6 +24,7 @@ TPU_BATCH = "tpu-batch"
 TPU_BATCH_SINGLE_AZ = "tpu-batch-single-az"
 TPU_BATCH_AZ_AWARE = "tpu-batch-az-aware"
 TPU_BATCH_MIN_FRAG = "tpu-batch-minimal-fragmentation"
+TPU_BATCH_EVENLY = "tpu-batch-distribute-evenly"
 
 DEFAULT = DISTRIBUTE_EVENLY
 
@@ -75,12 +76,19 @@ def select_binpacker(
         SINGLE_AZ_MINIMAL_FRAGMENTATION,
     ):
         return _minfrag_binpacker(name, strict_reference_parity)
-    if name in (TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE, TPU_BATCH_MIN_FRAG):
+    if name in (
+        TPU_BATCH,
+        TPU_BATCH_SINGLE_AZ,
+        TPU_BATCH_AZ_AWARE,
+        TPU_BATCH_MIN_FRAG,
+        TPU_BATCH_EVENLY,
+    ):
         try:
             # imported lazily: pulls in jax
             from .batch_adapter import (
                 tpu_batch_az_aware_binpacker,
                 tpu_batch_binpacker,
+                tpu_batch_evenly_binpacker,
                 tpu_batch_min_frag_binpacker,
                 tpu_batch_single_az_binpacker,
             )
@@ -91,6 +99,8 @@ def select_binpacker(
                 return tpu_batch_single_az_binpacker()
             if name == TPU_BATCH_AZ_AWARE:
                 return tpu_batch_az_aware_binpacker()
+            if name == TPU_BATCH_EVENLY:
+                return tpu_batch_evenly_binpacker()
             return tpu_batch_binpacker()
         except ImportError:
             # fall back to the host policy with the SAME placement and
@@ -100,6 +110,7 @@ def select_binpacker(
                 TPU_BATCH_SINGLE_AZ: SINGLE_AZ_TIGHTLY_PACK,
                 TPU_BATCH_AZ_AWARE: AZ_AWARE_TIGHTLY_PACK,
                 TPU_BATCH_MIN_FRAG: MINIMAL_FRAGMENTATION,
+                TPU_BATCH_EVENLY: DISTRIBUTE_EVENLY,
             }[name]
             logging.getLogger(__name__).error(
                 "binpack %r configured but the JAX batch solver could not be "
@@ -117,5 +128,11 @@ def select_binpacker(
 def available_binpackers() -> list[str]:
     return sorted(
         _REGISTRY.keys()
-        | {TPU_BATCH, TPU_BATCH_SINGLE_AZ, TPU_BATCH_AZ_AWARE, TPU_BATCH_MIN_FRAG}
+        | {
+            TPU_BATCH,
+            TPU_BATCH_SINGLE_AZ,
+            TPU_BATCH_AZ_AWARE,
+            TPU_BATCH_MIN_FRAG,
+            TPU_BATCH_EVENLY,
+        }
     )

@@ -95,9 +95,9 @@ class Server:
                 )
                 from ..ops.tensorize import APP_BUCKETS, NODE_BUCKETS
 
-                minfrag = self.extender.binpacker.name.endswith(
-                    "minimal-fragmentation"
-                )
+                name = self.extender.binpacker.name
+                minfrag = name.endswith("minimal-fragmentation")
+                evenly = name.endswith("distribute-evenly")
                 for nb in NODE_BUCKETS[:3]:  # the shapes real clusters hit first
                     if self._warm_stop.is_set():
                         return
@@ -109,7 +109,9 @@ class Server:
                     # the FIFO path's first-called kernel (smallest app bucket)
                     ab = APP_BUCKETS[0]
                     queue_fn = solve_queue_min_frag if minfrag else solve_queue
-                    queue_kwargs = {} if minfrag else {"evenly": False}
+                    # evenly is a static jit argname: warming the wrong
+                    # variant would leave the production one uncompiled
+                    queue_kwargs = {} if minfrag else {"evenly": evenly}
                     queue_fn(
                         avail,
                         rank,

@@ -511,3 +511,103 @@ def test_extender_tpu_batch_min_frag_matches_host():
         finally:
             h.close()
     assert results["minimal-fragmentation"] == results["tpu-batch-minimal-fragmentation"]
+
+
+def test_fifo_efficiency_metrics_match_host_lane():
+    """The efficiency gauge must reflect POST-queue availability like the
+    host lane, whose fitEarlierDrivers mutates the metadata the final
+    pack's efficiencies are computed against (resource.go:255-259).  The
+    device lane carries availability on device, so its result's
+    efficiencies must be bit-equal to the host's mutated-metadata ones."""
+    from k8s_spark_scheduler_tpu.ops.efficiency import (
+        compute_avg_packing_efficiency,
+    )
+
+    rng = random.Random(7171)
+    solver = TpuFifoSolver()
+    checked = 0
+    for trial in range(12):
+        metadata = random_cluster(rng, rng.randint(3, 15))
+        driver_order, executor_order = orders_for(metadata, rng)
+        earlier = [random_app(rng) for _ in range(rng.randint(1, 6))]
+        skip_allowed = [True] * len(earlier)  # queue never hard-fails
+        current = random_app(rng)
+
+        expected_ok, expected = host_fifo_oracle(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        outcome = solver.solve(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        assert outcome.supported and outcome.earlier_ok == expected_ok
+        if not (expected_ok and expected.has_capacity):
+            continue
+        result = outcome.result
+        # the extender's gauge inputs: avg over the result's efficiency map
+        exp_avg = compute_avg_packing_efficiency(
+            metadata, list(expected.packing_efficiencies.values())
+        )
+        act_avg = compute_avg_packing_efficiency(
+            metadata, list(result.packing_efficiencies.values())
+        )
+        assert (exp_avg.cpu, exp_avg.memory, exp_avg.gpu, exp_avg.max) == (
+            act_avg.cpu, act_avg.memory, act_avg.gpu, act_avg.max
+        ), f"trial {trial}: gauge averages diverge"
+        # spot-check per-node values on the placement nodes
+        for node in {expected.driver_node, *expected.executor_nodes}:
+            e, a = expected.packing_efficiencies[node], result.packing_efficiencies[node]
+            assert (e.cpu, e.memory, e.gpu) == (a.cpu, a.memory, a.gpu), (
+                f"trial {trial}: node {node}"
+            )
+        checked += 1
+    assert checked >= 5  # the scenario generator must exercise the path
+
+
+@pytest.mark.parametrize(
+    "host_algo,device_algo",
+    [
+        ("tightly-pack", "tpu-batch"),
+        ("distribute-evenly", "tpu-batch-distribute-evenly"),
+        ("minimal-fragmentation", "tpu-batch-minimal-fragmentation"),
+    ],
+)
+def test_extender_efficiency_gauge_matches_host_lane(host_algo, device_algo):
+    """The packing.efficiency.max gauge must be bit-equal whichever lane
+    serves the request — through the FULL extender (the tensor-snapshot
+    fast lane, metadata containing a non-candidate unschedulable node,
+    and a non-empty FIFO queue)."""
+    import time as _t
+
+    def run(algo):
+        h = Harness(binpack_algo=algo, is_fifo=True)
+        try:
+            h.new_node("n1", cpu="8", memory="8Gi", gpu="0")
+            h.new_node("n2", cpu="12", memory="12Gi", gpu="0")
+            # in metadata (affinity-matching) but never a candidate:
+            # the gauge averages over it on the host lane
+            h.new_node("n3", cpu="6", memory="6Gi", gpu="0", unschedulable=True)
+            t0 = _t.time()
+            elder = h.static_allocation_spark_pods(
+                "app-elder", 4, creation_timestamp=t0 - 50
+            )
+            newer = h.static_allocation_spark_pods("app-next", 2, creation_timestamp=t0)
+            for p in elder + newer:
+                h.create_pod(p)
+            r = h.schedule(newer[0], ["n1", "n2", "n3"])
+            assert r.node_names, (algo, r.failed_nodes, r.error)
+            gauges = {
+                k: v
+                for k, v in h.extender._metrics.snapshot()["gauges"].items()
+                if "packing.efficiency.max" in k
+            }
+            assert len(gauges) == 1
+            return r.node_names[0], next(iter(gauges.values()))
+        finally:
+            h.close()
+
+    host_node, host_gauge = run(host_algo)
+    dev_node, dev_gauge = run(device_algo)
+    assert host_node == dev_node
+    assert host_gauge == dev_gauge, (
+        f"{device_algo} gauge {dev_gauge!r} != {host_algo} gauge {host_gauge!r}"
+    )

@@ -80,6 +80,12 @@ def random_cluster(rng: random.Random, n_nodes: int) -> dict:
         else:
             mem = f"{rng.randint(1, 64)}Gi"
         gpu = str(rng.randint(0, 8)) if rng.random() < 0.25 else "0"
+        # overbooked nodes (overhead > allocatable drives availability
+        # negative, resources.go:61-100 has no floor)
+        if rng.random() < 0.05:
+            cpu = str(-rng.randint(1, 8))
+        if rng.random() < 0.03:
+            mem = f"-{rng.randint(1, 8)}Gi"
         metadata[f"n{i:04d}"] = NodeSchedulingMetadata(
             available=Resources.of(cpu, mem, gpu),
             schedulable=Resources.of("64", "64Gi", "8"),
@@ -96,11 +102,98 @@ def random_gang(rng: random.Random, n_nodes: int):
         str(rng.randint(0, 1)) if rng.random() < 0.2 else "0",
     )
     executor = Resources.of(
-        str(rng.randint(1, 16)), f"{rng.randint(1, 16)}Gi",
+        str(rng.randint(1, 16)) if rng.random() > 0.06 else "0",
+        f"{rng.randint(1, 16)}Gi" if rng.random() > 0.06 else "0",
         str(rng.randint(0, 2)) if rng.random() < 0.2 else "0",
     )
     count = rng.randint(0, max(2 * n_nodes, 4))
     return driver, executor, count
+
+
+def host_fifo_loop(metadata, driver_order, executor_order, queue, current, packer):
+    """fitEarlierDrivers + final pack on the host oracle (resource.go:
+    224-262); every earlier driver is enforced (skip never allowed)."""
+    from k8s_spark_scheduler_tpu.scheduler.sparkpods import spark_resource_usage
+    from k8s_spark_scheduler_tpu.types.resources import (
+        copy_metadata,
+        subtract_usage_if_exists,
+    )
+
+    meta = copy_metadata(metadata)
+    for driver_res, executor_res, count in queue:
+        result = packer(driver_res, executor_res, count, driver_order, executor_order, meta)
+        if not result.has_capacity:
+            return False, None
+        subtract_usage_if_exists(
+            meta,
+            spark_resource_usage(
+                driver_res, executor_res, result.driver_node, result.executor_nodes
+            ),
+        )
+    return True, packer(*current, driver_order, executor_order, meta)
+
+
+def queue_fuzz(rng, metadata, driver_order, executor_order, report):
+    """FIFO queue solvers (one-dispatch device scans) vs the host loop."""
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import (
+        TpuFifoSolver,
+        TpuSingleAzFifoSolver,
+    )
+    from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
+
+    queue_pairs = [
+        ("queue/tightly-pack", TpuFifoSolver("tightly-pack"), packers.tightly_pack),
+        (
+            "queue/distribute-evenly",
+            TpuFifoSolver("distribute-evenly"),
+            packers.distribute_evenly,
+        ),
+        (
+            "queue/minimal-fragmentation",
+            TpuFifoSolver("minimal-fragmentation"),
+            packers.minimal_fragmentation_pack,
+        ),
+        (
+            "queue/single-az",
+            TpuSingleAzFifoSolver(az_aware=False),
+            packers.single_az_tightly_pack,
+        ),
+        (
+            "queue/az-aware",
+            TpuSingleAzFifoSolver(az_aware=True),
+            packers.az_aware_tightly_pack,
+        ),
+    ]
+    n_nodes = len(metadata)
+    queue = [random_gang(rng, n_nodes) for _ in range(rng.randint(1, 6))]
+    current = random_gang(rng, n_nodes)
+    apps = [AppDemand(*g) for g in queue]
+    cur_app = AppDemand(*current)
+    bad = 0
+    ran = 0
+    for name, solver, oracle in queue_pairs:
+        want_ok, want = host_fifo_loop(
+            metadata, driver_order, executor_order, queue, current, oracle
+        )
+        got = solver.solve(
+            metadata, driver_order, executor_order, apps, [False] * len(apps), cur_app
+        )
+        if not got.supported:
+            continue  # snapshot outside the device lane's bounds
+        ran += 1
+        mismatch = got.earlier_ok != want_ok
+        if not mismatch and want_ok:
+            mismatch = got.result.has_capacity != want.has_capacity or (
+                want.has_capacity
+                and (
+                    got.result.driver_node != want.driver_node
+                    or got.result.executor_nodes != want.executor_nodes
+                )
+            )
+        if mismatch:
+            bad += 1
+            report(name, got, want_ok, want)
+    return bad, ran
 
 
 def main() -> int:
@@ -109,6 +202,10 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=987654)
     ap.add_argument("--min-nodes", type=int, default=3)
     ap.add_argument("--max-nodes", type=int, default=700)
+    ap.add_argument(
+        "--queue-max-nodes", type=int, default=120,
+        help="node cap for the (slower) FIFO-queue differential section",
+    )
     args = ap.parse_args()
 
     rng = random.Random(args.seed)
@@ -154,6 +251,19 @@ def main() -> int:
                     f"{want.executor_nodes[:8]}...",
                     file=sys.stderr,
                 )
+        if n_nodes <= args.queue_max_nodes:
+
+            def report(name, got, want_ok, want):
+                print(
+                    f"QUEUE MISMATCH trial={trial} policy={name} nodes={n_nodes}\n"
+                    f"  device: earlier_ok={got.earlier_ok} result={got.result}\n"
+                    f"  oracle: earlier_ok={want_ok} result={want}",
+                    file=sys.stderr,
+                )
+
+            bad, ran = queue_fuzz(rng, metadata, driver_order, executor_order, report)
+            mismatches += bad
+            comparisons += ran
         if (trial + 1) % 25 == 0:
             print(
                 f"# {trial + 1}/{args.trials} trials, {comparisons} comparisons, "
