@@ -245,63 +245,79 @@ def min_frag_capacity(
 
 def min_frag_counts(cap: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
     """Minimal-fragmentation per-node executor counts from unclamped
-    capacities — the whole of minimal_fragmentation.go:59-137 as O(N log N)
-    vector ops, no data-dependent loop.
+    capacities — the whole of minimal_fragmentation.go:59-137 as
+    sort-free vector ops, no data-dependent loop.
 
-    The drain loop linearizes: sorting capacities descending (ties by
-    executor priority), a node is fully drained iff its capacity is
-    strictly below what remains when it becomes the max
-    (d_j < k − Σ_{i<j} d_i); the first position where that fails is the
-    final step, and the remaining k* executors go to the smallest
+    The drain loop linearizes over capacity *value classes*: with
+    T(v) = Σ_{cap ≥ v} cap, a class v is fully drained iff T(v) < k, so
+    the stop class v* = max{v : T(v) ≥ k} (binary-searched in 31
+    probes).  Entering v* with R = k − Σ_{cap > v*} cap remaining,
+    t* = ⌈R/v*⌉ − 1 of its nodes (earliest in priority order) drain
+    fully and the final k* = R − t*·v* executors go to the smallest
     remaining capacity ≥ k* (earliest priority among equals) — exactly
-    the bisect the host runs.  The (k+max)/2 "avoid mostly-empty nodes"
-    subset attempt (minimal_fragmentation.go:71-87) is the same
-    computation under a tighter eligibility mask, so both runs share one
-    sort.  Only valid when Σ min(cap, k) ≥ k (the caller's solve_app
-    feasibility); returns zeros otherwise and for k = 0."""
+    the host's ascending bisect.  Probe sums clamp per-term to k so
+    everything stays int32 (Σ min(cap,k) ≤ N·k, the scale_problem
+    guard); drained classes all have cap < k so the exact prefix sum
+    Σ_{cap > v*} cap < k needs no widening.  The (k+max)/2
+    "avoid mostly-empty nodes" subset attempt
+    (minimal_fragmentation.go:71-87) is the same computation under a
+    tighter eligibility mask.  Only valid when Σ min(cap, k) ≥ k (the
+    caller's solve_app feasibility); returns zeros otherwise and for
+    k = 0."""
     n = cap.shape[0]
     elig = cap > 0
+    d = jnp.where(elig, cap, 0)
     iota = jnp.arange(n, dtype=jnp.int32)
-    # sort key: capacity descending, original (priority) index ascending;
-    # ineligible nodes get a positive key so they sort after all eligible
-    neg = jnp.where(elig, -cap, 1)
-    srt_neg, srt_idx = lax.sort((neg, iota), num_keys=2)
-    d = jnp.where(srt_neg < 0, -srt_neg, 0)
-    selig = srt_neg < 0
-    pos = jnp.arange(n, dtype=jnp.int32)
 
     def run(sub):
         """One _internal_minimal_fragmentation pass over the eligibility
-        mask `sub` (in sorted space).  Returns (ok, counts-by-node)."""
+        mask `sub`.  Returns (ok, counts-by-node)."""
         dd = jnp.where(sub, d, 0)
-        prefix = jnp.cumsum(dd) - dd  # exclusive; exact while k_j > 0
-        kj = k - prefix
-        stop = sub & (d >= kj) & (kj > 0)
-        ok = jnp.any(stop)
-        jstar = jnp.argmax(stop).astype(jnp.int32)
-        kstar = jnp.maximum(k - prefix[jstar], 0)
-        drained = sub & (pos < jstar)
+        dc = jnp.minimum(dd, k)  # probe terms, int32-safe to sum
+        ok = (jnp.sum(dc) >= k) & (k > 0)
+
+        def body(_, lohi):
+            lo, hi = lohi
+            mid = lo + (hi - lo + 1) // 2
+            good = jnp.sum(jnp.where(dd >= mid, dc, 0)) >= k
+            return (jnp.where(good, mid, lo), jnp.where(good, hi, mid - 1))
+
+        # fixed 31 probes cover the full int32 capacity domain.  A
+        # lax.while_loop bounded by max(dd) (~7 probes for real caps)
+        # measures no better and its dynamic trip count inside the queue
+        # scan sends XLA compile time pathological (>10min vs seconds) —
+        # keep the static loop.
+        vstar, _ = lax.fori_loop(
+            0, 31, body, (jnp.int32(1), jnp.int32(MF_SENT))
+        )
+        s = jnp.sum(jnp.where(dd > vstar, dd, 0))  # drained classes, < k
+        r = k - s
+        tstar = jnp.maximum(r - 1, 0) // vstar
+        kstar = r - tstar * vstar
+        at = sub & (dd == vstar)
+        at_i = at.astype(jnp.int32)
+        at_rank = jnp.cumsum(at_i) - at_i  # class position in priority order
+        drained = (sub & (dd > vstar)) | (at & (at_rank < tstar))
         # final placement: smallest capacity ≥ k* among the not-drained,
         # ties to the earliest priority index (the ascending bisect)
-        cand = sub & (pos >= jstar) & (d >= kstar)
-        mincap = jnp.min(jnp.where(cand, d, BIG))
-        partial = jnp.min(jnp.where(cand & (d == mincap), srt_idx, jnp.int32(n)))
-        counts = jnp.zeros((n,), jnp.int32).at[srt_idx].set(jnp.where(drained, dd, 0))
-        partial_safe = jnp.minimum(partial, n - 1)
-        counts = counts.at[partial_safe].add(jnp.where(ok, kstar, 0))
-        return ok, counts
+        cand = sub & ~drained & (dd >= kstar)
+        vp = jnp.min(jnp.where(cand, dd, BIG))
+        partial = jnp.argmax(cand & (dd == vp)).astype(jnp.int32)
+        counts = jnp.where(drained, dd, 0)
+        counts = counts + jnp.where((iota == partial) & ok, kstar, 0)
+        return ok, jnp.where(ok, counts, jnp.zeros_like(counts))
 
-    max_cap = jnp.max(jnp.where(selig, d, 0))
-    has_sent = jnp.any(selig & (d == MF_SENT))
+    max_cap = jnp.max(d)
+    has_sent = jnp.any(elig & (d == MF_SENT))
     # exact (k + max)//2 without int32 overflow; with an unbounded node
     # the host threshold (k + 2^63-1)//2 admits every bounded capacity
     target = (k // 2) + (max_cap // 2) + (((k & 1) + (max_cap & 1)) // 2)
-    subset = selig & jnp.where(has_sent, d < MF_SENT, d < target)
+    subset = elig & jnp.where(has_sent, d < MF_SENT, d < target)
     attempt = has_sent | (k < max_cap)
     sub_ok, sub_counts = run(subset & attempt)
-    full_ok, full_counts = run(selig)
+    full_ok, full_counts = run(elig)
     counts = jnp.where(attempt & sub_ok, sub_counts, full_counts)
-    return jnp.where(full_ok & (k > 0), counts, jnp.zeros_like(counts))
+    return jnp.where(full_ok, counts, jnp.zeros_like(counts))
 
 
 @functools.partial(jax.jit, static_argnames=("with_placements",))

@@ -798,19 +798,18 @@ class SparkSchedulerExtender:
         executor_resources,
         zone: Optional[str],
     ):
-        """First-fit executor reschedule served entirely from the tensor
-        mirror: AZ-aware executor order (including label priority) and the
-        fit check in vectorized integer math.  Returns (hit, node_name)
-        or None to use the Quantity path.  Decision parity: availability
+        """Executor reschedule served entirely from the tensor mirror:
+        AZ-aware executor order (including label priority) and the fit
+        check in vectorized integer math.  Returns (hit, node_name) or
+        None to use the Quantity path.  Decision parity: availability
         rows equal the slow path's alloc − reserved − overhead exactly
-        (tests/test_tensor_snapshot.py), the double-overhead reschedule
+        (tests/test_tensor_snapshot.py); the double-overhead reschedule
         quirk applies to reservation-entry nodes under strict parity
-        (compat.py #1), and min-frag's app-attraction variant is not
-        tensorized (falls back)."""
+        (compat.py #1).  The single-az-minimal-fragmentation policy's
+        app-attraction variant (resource.go:675-703) is served as a
+        vectorized lexicographic min instead of first-fit."""
         self.last_reschedule_path = "slow"
         if self._tensor_snapshot is None or not self._fast_path_ok:
-            return None
-        if self.binpacker.name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
             return None
         try:
             from ..ops.fast_path import executor_reschedule_order
@@ -829,13 +828,21 @@ class SparkSchedulerExtender:
             if built is None:
                 return None
             names, avail, overhead, res_entry = built
+            row = np.array(exec_row, dtype=np.int64)
+            if self.binpacker.name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
+                hit_name = self._fast_min_frag_reschedule(
+                    executor, names, avail, overhead, row
+                )
+                self.last_reschedule_path = "fast"
+                if hit_name is not None:
+                    return True, hit_name
+                return False, None
             fit_avail = avail
             if self._strict_reference_parity and len(names):
                 # QUIRK #1 (resource.go:638-643): nodes with a usage
                 # entry see overhead subtracted twice on this path
                 fit_avail = avail.copy()
                 fit_avail[res_entry] -= overhead[res_entry]
-            row = np.array(exec_row, dtype=np.int64)
             fits = (fit_avail >= row[None, :]).all(axis=1)
             hit = np.flatnonzero(fits)
             self.last_reschedule_path = "fast"
@@ -845,6 +852,42 @@ class SparkSchedulerExtender:
         except Exception:
             logger.exception("fast reschedule lane failed; using Quantity path")
             return None
+
+    def _fast_min_frag_reschedule(self, executor, names, avail, overhead, row):
+        """resource.go:675-703 from the mirror: capacity per node with
+        overhead passed as the reserved map (the reference's
+        GetNodeCapacities call — net DOUBLE overhead on top of the
+        availability rows, which already subtract it once; unconditional
+        in the reference, unlike the first-fit branch's flagged quirk),
+        then the best node = lexicographic min of (not-hosting-this-app,
+        capacity, priority position) among capacity ≥ 1 — identical to
+        the sequential strict-improvement loop."""
+        if not len(names):
+            return None
+        # capacity_against_single_dimension per dim: reserved > available
+        # → 0; zero requirement → unbounded; else exact floor division
+        diff = avail - overhead
+        per_dim = np.where(
+            overhead > avail,
+            np.int64(0),
+            np.where(
+                row[None, :] == 0,
+                np.int64(2**62),
+                np.floor_divide(diff, np.maximum(row[None, :], 1)),
+            ),
+        )
+        cap = per_dim.min(axis=1)
+        candidates = np.flatnonzero(cap >= 1)
+        if not len(candidates):
+            return None
+        app_nodes = self._get_nodes_with_executors_belonging_to_same_app(executor)
+        not_in_app = np.fromiter(
+            (names[i] not in app_nodes for i in candidates),
+            dtype=bool,
+            count=len(candidates),
+        )
+        order = np.lexsort((candidates, cap[candidates], not_in_app))
+        return names[int(candidates[order[0]])]
 
     def _reschedule_executor_with_minimal_fragmentation(
         self,

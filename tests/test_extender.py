@@ -217,6 +217,9 @@ def test_fast_reschedule_lane_engages_and_matches_slow_lane():
             LabelPriorityOrder("pool", ["reserved", "spot"]),
         ),
         "zone": ("single-az-tightly-pack", True, None),
+        # exercises the vectorized min-frag reschedule (app-attraction +
+        # least-capacity, resource.go:675-703) against the Quantity loop
+        "minfrag-zone": ("single-az-minimal-fragmentation", True, None),
     }
     for variant, (algo, single_az, label_prio) in variants.items():
         for strict in (True, False):
