@@ -123,6 +123,35 @@ def minimal_fragmentation_assignment(
     return nodes if ok else None
 
 
+def min_frag_zone_decode(
+    names: List[str],
+    avail_rows: np.ndarray,
+    exec_row: np.ndarray,
+    zone_exec_ok: np.ndarray,
+    d_idx: int,
+    driver_row: np.ndarray,
+    k: int,
+    strict_reference_parity: bool,
+):
+    """Per-zone minimal-fragmentation decode shared by the single-AZ
+    single-app adapter and the FIFO solver's zone-choice lane: exact
+    bisect placements on device-equal capacities, the true per-node
+    counts (for the usage carry), and the efficiency-side counts —
+    zeroed under strict parity, where the reference's no-write-back
+    quirk makes the zone choice see only the driver's reservation.
+    Returns (executor_nodes, counts, eff_counts) or None (infeasible)."""
+    zcap = min_frag_unclamped_caps(avail_rows, exec_row, zone_exec_ok, d_idx, driver_row)
+    executor_nodes = minimal_fragmentation_assignment(names, zcap, k)
+    if executor_nodes is None:
+        return None
+    counts = np.zeros(len(names), dtype=np.int64)
+    pos = {name: i for i, name in enumerate(names)}
+    for node in executor_nodes:
+        counts[pos[node]] += 1
+    eff_counts = np.zeros_like(counts) if strict_reference_parity else counts
+    return executor_nodes, counts, eff_counts
+
+
 def counts_to_tightly_list(names: List[str], counts: np.ndarray) -> List[str]:
     out: List[str] = []
     for name, c in zip(names, counts):
@@ -381,10 +410,26 @@ class TpuSingleAzBinpacker:
     """Single-AZ combinator on device (single_az.go:23-55): all zones
     solved in one vmapped call, zone chosen on host with the oracle's
     exact efficiency math (_choose_best_result).  az_aware=True adds the
-    cross-zone fallback (az_aware_pack_tightly.go:27-38)."""
+    cross-zone fallback (az_aware_pack_tightly.go:27-38).
 
-    def __init__(self, az_aware: bool = False):
+    inner_policy selects the per-zone distribution: "tightly-pack"
+    (device counts) or "minimal-fragmentation"
+    (single_az_minimal_fragmentation semantics: zone feasibility and
+    driver choice are policy-invariant, so the vmapped zone solves are
+    shared; placements come from the exact host bisect on device-equal
+    capacities, and under strict parity the reference's
+    no-efficiency-write-back quirk makes the zone choice see only the
+    driver's reservation)."""
+
+    def __init__(
+        self,
+        az_aware: bool = False,
+        inner_policy: str = "tightly-pack",
+        strict_reference_parity: bool = compat.DEFAULT_STRICT,
+    ):
         self.az_aware = az_aware
+        self.inner_policy = inner_policy
+        self.strict_reference_parity = strict_reference_parity
 
     def __call__(
         self,
@@ -407,9 +452,16 @@ class TpuSingleAzBinpacker:
             [app_resources_of(driver_resources, executor_resources, executor_count)]
         )
         problem = scale_problem(cluster, apps)
-        oracle = (
-            packers.az_aware_tightly_pack if self.az_aware else packers.single_az_tightly_pack
-        )
+        if self.inner_policy == "minimal-fragmentation":
+            oracle = packers.make_single_az_minimal_fragmentation(
+                self.strict_reference_parity
+            )
+        else:
+            oracle = (
+                packers.az_aware_tightly_pack
+                if self.az_aware
+                else packers.single_az_tightly_pack
+            )
         if not problem.ok:
             logger.warning("snapshot not exactly tensorizable; using host oracle")
             return oracle(
@@ -442,20 +494,39 @@ class TpuSingleAzBinpacker:
         counts = np.asarray(solves.exec_counts)
 
         results = []
+        exec_ok_arr = np.asarray(problem.exec_ok[:n])
         for zi, zone in enumerate(candidate_zones):
             if not feasible[zi]:
                 continue
-            driver_node = names[int(driver_idx[zi])]
-            zone_counts = counts[zi][:n]
+            d_idx = int(driver_idx[zi])
+            driver_node = names[d_idx]
+            if self.inner_policy == "minimal-fragmentation":
+                decoded = min_frag_zone_decode(
+                    names,
+                    problem.avail[:n],
+                    problem.executor[0],
+                    exec_ok_arr & zone_masks[zi][:n],
+                    d_idx,
+                    problem.driver[0],
+                    executor_count,
+                    self.strict_reference_parity,
+                )
+                if decoded is None:  # unreachable: zone feasibility proven
+                    continue
+                executor_nodes, _counts, eff_counts = decoded
+            else:
+                zone_counts = counts[zi][:n]
+                executor_nodes = counts_to_tightly_list(names, zone_counts)
+                eff_counts = zone_counts
             results.append(
                 PackingResult(
                     driver_node=driver_node,
-                    executor_nodes=counts_to_tightly_list(names, zone_counts),
+                    executor_nodes=executor_nodes,
                     has_capacity=True,
                     packing_efficiencies=compute_packing_efficiencies(
                         metadata,
                         build_reserved(
-                            names, zone_counts, driver_node, driver_resources, executor_resources
+                            names, eff_counts, driver_node, driver_resources, executor_resources
                         ),
                     ),
                 )
@@ -490,6 +561,27 @@ def tpu_batch_single_az_binpacker() -> Binpacker:
         binpack_func=TpuSingleAzBinpacker(az_aware=False),
         is_single_az=True,
         queue_solver=TpuSingleAzFifoSolver(az_aware=False),
+    )
+
+
+def tpu_batch_single_az_min_frag_binpacker(
+    strict_reference_parity: bool = compat.DEFAULT_STRICT,
+) -> Binpacker:
+    from .fifo_solver import TpuSingleAzFifoSolver
+
+    return Binpacker(
+        name="tpu-batch-single-az-minimal-fragmentation",
+        binpack_func=TpuSingleAzBinpacker(
+            az_aware=False,
+            inner_policy="minimal-fragmentation",
+            strict_reference_parity=strict_reference_parity,
+        ),
+        is_single_az=True,
+        queue_solver=TpuSingleAzFifoSolver(
+            az_aware=False,
+            inner_policy="minimal-fragmentation",
+            strict_reference_parity=strict_reference_parity,
+        ),
     )
 
 

@@ -25,6 +25,7 @@ from .batch_adapter import (
     counts_to_tightly_list,
     evenly_counts,
     min_frag_unclamped_caps,
+    min_frag_zone_decode,
     minimal_fragmentation_assignment,
 )
 from .efficiency import compute_packing_efficiencies
@@ -444,10 +445,26 @@ class TpuSingleAzFifoSolver:
     ("fused" / "host") for tests and diagnostics."""
 
     def __init__(
-        self, az_aware: bool = False, backend: str = "auto", interpret: bool = False
+        self,
+        az_aware: bool = False,
+        backend: str = "auto",
+        interpret: bool = False,
+        inner_policy: str = "tightly-pack",
+        strict_reference_parity: bool = compat.DEFAULT_STRICT,
     ):
+        # inner_policy "minimal-fragmentation" gives the
+        # single-az-minimal-fragmentation semantics: zone feasibility and
+        # driver choice are shared with tightly (work-conserving drain),
+        # placements come from the host bisect on the carried scaled
+        # availability, and the zone choice sees driver-only reserved
+        # under strict parity (the reference's no-write-back quirk).  It
+        # always runs the host zone-choice lane (the fused kernel packs
+        # tightly); az_aware has no min-frag variant in the reference.
+        assert not (az_aware and inner_policy == "minimal-fragmentation")
         self.az_aware = az_aware
         self.backend = backend
+        self.inner_policy = inner_policy
+        self.strict_reference_parity = strict_reference_parity
         # interpret=True runs the pallas kernel in interpreter mode so the
         # solver-side pallas wiring is testable on CPU
         self.interpret = interpret
@@ -492,6 +509,9 @@ class TpuSingleAzFifoSolver:
 
         avail = problem.avail.astype(np.int32).copy()  # scaled, mutated per driver
 
+        minfrag_inner = self.inner_policy == "minimal-fragmentation"
+        exec_ok_arr = np.asarray(problem.exec_ok[:n])
+
         def pack_one(app_idx: int):
             """Device zone solves + host zone choice for one app.
             Returns (driver_idx, counts) or None when infeasible."""
@@ -516,19 +536,38 @@ class TpuSingleAzFifoSolver:
                 if not feasible[zi]:
                     continue
                 d_idx = int(driver_idx[zi])
-                zone_counts = counts_all[zi][:n]
+                if minfrag_inner:
+                    # exact host bisect on the carried scaled availability
+                    # (capacities are scale-invariant); placement order is
+                    # the drain order, not priority order
+                    decoded = min_frag_zone_decode(
+                        names,
+                        avail.astype(np.int64)[:n],
+                        problem.executor[app_idx],
+                        exec_ok_arr & zone_masks[zi][:n],
+                        d_idx,
+                        problem.driver[app_idx],
+                        int(problem.count[app_idx]),
+                        self.strict_reference_parity,
+                    )
+                    if decoded is None:  # unreachable: zone feasible
+                        continue
+                    executor_nodes, zone_counts, eff_counts = decoded
+                    eff_rows = _reserved_rows(n, d_idx, eff_counts, problem, app_idx)
+                else:
+                    zone_counts = counts_all[zi][:n]
+                    executor_nodes = counts_to_tightly_list(names, zone_counts)
+                    eff_rows = _reserved_rows(n, d_idx, zone_counts, problem, app_idx)
                 results.append(
                     PackingResult(
                         driver_node=names[d_idx],
-                        executor_nodes=counts_to_tightly_list(names, zone_counts),
+                        executor_nodes=executor_nodes,
                         has_capacity=True,
                         packing_efficiencies=efficiencies_from_rows(
                             names,
                             cluster.sched,
                             avail.astype(np.int64) * scale[None, :],
-                            _reserved_rows(
-                                n, d_idx, zone_counts, problem, app_idx
-                            ) * scale[None, :],
+                            eff_rows * scale[None, :],
                         ),
                     )
                 )
@@ -552,7 +591,10 @@ class TpuSingleAzFifoSolver:
         # None = no queue pass ran (empty queue); "fused"/"host" report
         # which lane actually processed earlier drivers
         self.last_path = None
-        if n_earlier > 0:
+        # the fused kernels pack tightly and score full reservations —
+        # both wrong for the min-frag inner policy (bisect placements,
+        # driver-only strict scores): it must take the host lane
+        if n_earlier > 0 and not minfrag_inner:
             eff_inputs = _fused_efficiency_inputs(cluster, problem)
             if eff_inputs is not None:
                 s_cpu, s_gpu, inv_m, th_m, scale_c, scale_g = eff_inputs

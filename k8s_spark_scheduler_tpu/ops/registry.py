@@ -25,6 +25,7 @@ TPU_BATCH_SINGLE_AZ = "tpu-batch-single-az"
 TPU_BATCH_AZ_AWARE = "tpu-batch-az-aware"
 TPU_BATCH_MIN_FRAG = "tpu-batch-minimal-fragmentation"
 TPU_BATCH_EVENLY = "tpu-batch-distribute-evenly"
+TPU_BATCH_SINGLE_AZ_MIN_FRAG = "tpu-batch-single-az-minimal-fragmentation"
 
 DEFAULT = DISTRIBUTE_EVENLY
 
@@ -82,6 +83,7 @@ def select_binpacker(
         TPU_BATCH_AZ_AWARE,
         TPU_BATCH_MIN_FRAG,
         TPU_BATCH_EVENLY,
+        TPU_BATCH_SINGLE_AZ_MIN_FRAG,
     ):
         try:
             # imported lazily: pulls in jax
@@ -91,6 +93,7 @@ def select_binpacker(
                 tpu_batch_evenly_binpacker,
                 tpu_batch_min_frag_binpacker,
                 tpu_batch_single_az_binpacker,
+                tpu_batch_single_az_min_frag_binpacker,
             )
 
             if name == TPU_BATCH_MIN_FRAG:
@@ -101,6 +104,8 @@ def select_binpacker(
                 return tpu_batch_az_aware_binpacker()
             if name == TPU_BATCH_EVENLY:
                 return tpu_batch_evenly_binpacker()
+            if name == TPU_BATCH_SINGLE_AZ_MIN_FRAG:
+                return tpu_batch_single_az_min_frag_binpacker(strict_reference_parity)
             return tpu_batch_binpacker()
         except ImportError:
             # fall back to the host policy with the SAME placement and
@@ -111,6 +116,7 @@ def select_binpacker(
                 TPU_BATCH_AZ_AWARE: AZ_AWARE_TIGHTLY_PACK,
                 TPU_BATCH_MIN_FRAG: MINIMAL_FRAGMENTATION,
                 TPU_BATCH_EVENLY: DISTRIBUTE_EVENLY,
+                TPU_BATCH_SINGLE_AZ_MIN_FRAG: SINGLE_AZ_MINIMAL_FRAGMENTATION,
             }[name]
             logging.getLogger(__name__).error(
                 "binpack %r configured but the JAX batch solver could not be "
@@ -119,7 +125,10 @@ def select_binpacker(
                 fallback,
                 exc_info=True,
             )
-            if fallback == MINIMAL_FRAGMENTATION and not strict_reference_parity:
+            if not strict_reference_parity and fallback in (
+                MINIMAL_FRAGMENTATION,
+                SINGLE_AZ_MINIMAL_FRAGMENTATION,
+            ):
                 return _minfrag_binpacker(fallback, strict_reference_parity)
             return _REGISTRY[fallback]
     return _REGISTRY.get(name, _REGISTRY[DEFAULT])
@@ -134,5 +143,6 @@ def available_binpackers() -> list[str]:
             TPU_BATCH_AZ_AWARE,
             TPU_BATCH_MIN_FRAG,
             TPU_BATCH_EVENLY,
+            TPU_BATCH_SINGLE_AZ_MIN_FRAG,
         }
     )

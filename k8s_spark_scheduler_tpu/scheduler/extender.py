@@ -759,7 +759,7 @@ class SparkSchedulerExtender:
 
         _, executor_node_names = self._node_sorter.potential_nodes(metadata, node_names)
 
-        if self.binpacker.name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
+        if self._is_single_az_min_frag():
             name = self._reschedule_executor_with_minimal_fragmentation(
                 executor, executor_node_names, metadata, overhead, executor_resources
             )
@@ -773,6 +773,12 @@ class SparkSchedulerExtender:
         self._reschedule_miss(
             executor, executor_resources, should_schedule_into_single_az, single_az_zone
         )
+
+    def _is_single_az_min_frag(self) -> bool:
+        """Both the host policy and its tpu-batch counterpart use the
+        min-frag reschedule variant (resource.go:652's name check) — the
+        device name must not silently flip the variant to first-fit."""
+        return self.binpacker.name.endswith(SINGLE_AZ_MINIMAL_FRAGMENTATION)
 
     def _reschedule_miss(
         self, executor: Pod, executor_resources, into_single_az: bool, zone: str
@@ -829,7 +835,7 @@ class SparkSchedulerExtender:
                 return None
             names, avail, overhead, res_entry = built
             row = np.array(exec_row, dtype=np.int64)
-            if self.binpacker.name == SINGLE_AZ_MINIMAL_FRAGMENTATION:
+            if self._is_single_az_min_frag():
                 hit_name = self._fast_min_frag_reschedule(
                     executor, names, avail, overhead, row
                 )

@@ -86,18 +86,34 @@ class Server:
 
         def warm():
             try:
+                import numpy as _np
+
                 import jax.numpy as jnp
 
                 from ..ops.batch_solver import (
                     solve_queue,
                     solve_queue_min_frag,
+                    solve_queue_single_az,
                     solve_single,
+                    solve_zones_jit,
                 )
+                from ..ops.fifo_solver import _pallas_selected
                 from ..ops.tensorize import APP_BUCKETS, NODE_BUCKETS
 
+                # warm the kernels the configured policy's PRODUCTION
+                # path actually dispatches — on TPU the plain FIFO pass
+                # runs the pallas queue kernel, the single-AZ policies
+                # dispatch solve_zones / the fused single-AZ scan, and
+                # min-frag its own queue scan; evenly and with_placements
+                # are static jit argnames, so warming the wrong variant
+                # leaves the production one uncompiled
                 name = self.extender.binpacker.name
-                minfrag = name.endswith("minimal-fragmentation")
+                minfrag = name == "tpu-batch-minimal-fragmentation"
                 evenly = name.endswith("distribute-evenly")
+                single_az = "single-az" in name or name.endswith("az-aware")
+                saz_minfrag = name == "tpu-batch-single-az-minimal-fragmentation"
+                use_pallas = _pallas_selected("auto")
+                warm_zones = 3  # zone count is a compile shape; 3 AZs is typical
                 for nb in NODE_BUCKETS[:3]:  # the shapes real clusters hit first
                     if self._warm_stop.is_set():
                         return
@@ -106,23 +122,69 @@ class Server:
                     eok = jnp.zeros((nb,), bool)
                     row = jnp.zeros((3,), jnp.int32)
                     solve_single(avail, rank, eok, row, row, jnp.int32(0))
-                    # the FIFO path's first-called kernel (smallest app bucket)
                     ab = APP_BUCKETS[0]
-                    queue_fn = solve_queue_min_frag if minfrag else solve_queue
-                    # evenly is a static jit argname: warming the wrong
-                    # variant would leave the production one uncompiled
-                    queue_kwargs = {} if minfrag else {"evenly": evenly}
-                    queue_fn(
-                        avail,
-                        rank,
-                        eok,
+                    apps = (
                         jnp.zeros((ab, 3), jnp.int32),
                         jnp.zeros((ab, 3), jnp.int32),
                         jnp.zeros((ab,), jnp.int32),
                         jnp.zeros((ab,), bool),
-                        with_placements=False,
-                        **queue_kwargs,
                     )
+                    if single_az:
+                        # per-driver vmapped zone solves (host zone-choice
+                        # lane; the only queue lane for single-az min-frag)
+                        solve_zones_jit(
+                            avail, rank, eok,
+                            jnp.zeros((warm_zones, nb), bool),
+                            row, row, jnp.int32(0),
+                        )
+                    if single_az and saz_minfrag:
+                        pass  # no fused queue kernel for this policy
+                    elif single_az:
+                        az_aware = name.endswith("az-aware")
+                        if use_pallas:
+                            from ..ops.pallas_queue import (
+                                pallas_solve_queue_single_az,
+                            )
+
+                            pallas_solve_queue_single_az(
+                                avail, rank, eok,
+                                jnp.full((nb,), -1, jnp.int32),
+                                *apps,
+                                jnp.zeros((nb,), jnp.int32),
+                                jnp.zeros((nb,), jnp.int32),
+                                jnp.zeros((nb,), jnp.float32),
+                                jnp.zeros((nb,), jnp.int32),
+                                jnp.asarray(_np.array([1], _np.int32)),
+                                jnp.asarray(_np.array([1], _np.int32)),
+                                n_zones=warm_zones,
+                                az_aware=az_aware,
+                            )
+                        else:
+                            solve_queue_single_az(
+                                avail, rank, eok,
+                                jnp.zeros((warm_zones, nb), bool),
+                                *apps,
+                                jnp.zeros((nb,), jnp.int32),
+                                jnp.zeros((nb,), jnp.int32),
+                                jnp.zeros((nb,), jnp.float32),
+                                jnp.zeros((nb,), jnp.int32),
+                                jnp.int32(1),
+                                jnp.int32(1),
+                                az_aware=az_aware,
+                            )
+                    elif minfrag:
+                        solve_queue_min_frag(
+                            avail, rank, eok, *apps, with_placements=False
+                        )
+                    elif use_pallas:
+                        from ..ops.pallas_queue import pallas_solve_queue
+
+                        pallas_solve_queue(avail, rank, eok, *apps, evenly=evenly)
+                    else:
+                        solve_queue(
+                            avail, rank, eok, *apps,
+                            evenly=evenly, with_placements=False,
+                        )
             except Exception:
                 import logging
 

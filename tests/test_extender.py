@@ -218,8 +218,15 @@ def test_fast_reschedule_lane_engages_and_matches_slow_lane():
         ),
         "zone": ("single-az-tightly-pack", True, None),
         # exercises the vectorized min-frag reschedule (app-attraction +
-        # least-capacity, resource.go:675-703) against the Quantity loop
+        # least-capacity, resource.go:675-703) against the Quantity loop,
+        # on both the host policy name and its device-backed counterpart
+        # (the variant selection keys on the name suffix)
         "minfrag-zone": ("single-az-minimal-fragmentation", True, None),
+        "tpu-minfrag-zone": (
+            "tpu-batch-single-az-minimal-fragmentation",
+            True,
+            None,
+        ),
     }
     for variant, (algo, single_az, label_prio) in variants.items():
         for strict in (True, False):

@@ -611,3 +611,123 @@ def test_extender_efficiency_gauge_matches_host_lane(host_algo, device_algo):
     assert host_gauge == dev_gauge, (
         f"{device_algo} gauge {dev_gauge!r} != {host_algo} gauge {host_gauge!r}"
     )
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_single_az_min_frag_single_app_parity(strict):
+    """TpuSingleAzBinpacker(inner minimal-fragmentation) vs the host
+    single_az_minimal_fragmentation oracle, both parity modes (the
+    strict mode's driver-only efficiencies steer the zone choice)."""
+    from k8s_spark_scheduler_tpu.ops.batch_adapter import TpuSingleAzBinpacker
+
+    rng = random.Random(60606)
+    oracle = packers.make_single_az_minimal_fragmentation(strict)
+    solver = TpuSingleAzBinpacker(
+        az_aware=False,
+        inner_policy="minimal-fragmentation",
+        strict_reference_parity=strict,
+    )
+    checked = 0
+    for trial in range(30):
+        metadata = random_cluster(rng, rng.randint(2, 18))
+        app = random_app(rng)
+        driver_order, executor_order = orders_for(metadata, rng)
+        expected = oracle(
+            app.driver_resources, app.executor_resources, app.min_executor_count,
+            driver_order, executor_order, copy_metadata(metadata),
+        )
+        actual = solver(
+            app.driver_resources, app.executor_resources, app.min_executor_count,
+            driver_order, executor_order, copy_metadata(metadata),
+        )
+        assert actual.has_capacity == expected.has_capacity, f"trial {trial}"
+        if expected.has_capacity:
+            checked += 1
+            assert actual.driver_node == expected.driver_node, f"trial {trial}"
+            assert actual.executor_nodes == expected.executor_nodes, f"trial {trial}"
+    assert checked >= 8
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_single_az_min_frag_fifo_solver_parity(strict):
+    """TpuSingleAzFifoSolver(inner minimal-fragmentation) whole-queue
+    decisions vs the extender host loop on the oracle."""
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import TpuSingleAzFifoSolver
+
+    rng = random.Random(99)  # seed that exposed the ungated fused lane
+    oracle = packers.make_single_az_minimal_fragmentation(strict)
+    solver = TpuSingleAzFifoSolver(
+        az_aware=False,
+        inner_policy="minimal-fragmentation",
+        strict_reference_parity=strict,
+    )
+    for trial in range(40):
+        metadata = random_cluster(rng, rng.randint(2, 16))
+        driver_order, executor_order = orders_for(metadata, rng)
+        # queues always non-empty: the regression this pins (the fused
+        # tightly kernel serving the min-frag queue) only showed with
+        # earlier drivers present
+        earlier = [random_app(rng) for _ in range(rng.randint(1, 6))]
+        skip_allowed = [rng.random() < 0.3 for _ in earlier]
+        current = random_app(rng)
+
+        expected_ok, expected = host_fifo_oracle(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current,
+            packer=oracle,
+        )
+        outcome = solver.solve(
+            metadata, driver_order, executor_order, earlier, skip_allowed, current
+        )
+        assert outcome.supported
+        assert outcome.earlier_ok == expected_ok, f"trial {trial}"
+        if expected_ok:
+            assert outcome.result.has_capacity == expected.has_capacity, f"trial {trial}"
+            if expected.has_capacity:
+                assert outcome.result.driver_node == expected.driver_node, f"trial {trial}"
+                assert (
+                    outcome.result.executor_nodes == expected.executor_nodes
+                ), f"trial {trial}"
+
+
+def test_extender_tpu_batch_single_az_min_frag_matches_host():
+    """The new policy name through the full extender (FIFO + single-AZ
+    DA) must decide identically to the host policy."""
+    from k8s_spark_scheduler_tpu.config import Install
+
+    results = {}
+    for algo in (
+        "single-az-minimal-fragmentation",
+        "tpu-batch-single-az-minimal-fragmentation",
+    ):
+        h = Harness(
+            extra_install=Install(
+                fifo=True,
+                binpack_algo=algo,
+                should_schedule_dynamically_allocated_executors_in_same_az=True,
+            )
+        )
+        try:
+            h.new_node("a1", cpu="6", memory="6Gi", gpu="0", zone="az-1")
+            h.new_node("a2", cpu="10", memory="10Gi", gpu="0", zone="az-1")
+            h.new_node("b1", cpu="8", memory="8Gi", gpu="0", zone="az-2")
+            nodes = ["a1", "a2", "b1"]
+            log = []
+            for app, execs in [("a", 3), ("b", 5), ("c", 2)]:
+                pods = h.static_allocation_spark_pods(f"app-{app}", execs)
+                r = h.schedule(pods[0], nodes)
+                log.append((f"driver-{app}", tuple(r.node_names or [])))
+                if r.node_names:
+                    for p in pods[1:]:
+                        er = h.schedule(p, nodes)
+                        log.append((p.name, tuple(er.node_names or [])))
+            da = h.dynamic_allocation_spark_pods("app-da", 1, 3)
+            for p in da:
+                r = h.schedule(p, nodes)
+                log.append((p.name, tuple(r.node_names or [])))
+            results[algo] = log
+        finally:
+            h.close()
+    assert (
+        results["single-az-minimal-fragmentation"]
+        == results["tpu-batch-single-az-minimal-fragmentation"]
+    )

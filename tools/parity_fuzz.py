@@ -65,6 +65,18 @@ PAIRS = [
         TpuSingleAzBinpacker(az_aware=True),
         packers.az_aware_tightly_pack,
     ),
+    (
+        "single-az-minimal-fragmentation",
+        TpuSingleAzBinpacker(inner_policy="minimal-fragmentation"),
+        packers.single_az_minimal_fragmentation,
+    ),
+    (
+        "single-az-minimal-fragmentation/corrected",
+        TpuSingleAzBinpacker(
+            inner_policy="minimal-fragmentation", strict_reference_parity=False
+        ),
+        packers.make_single_az_minimal_fragmentation(False),
+    ),
 ]
 
 
@@ -162,6 +174,11 @@ def queue_fuzz(rng, metadata, driver_order, executor_order, report):
             "queue/az-aware",
             TpuSingleAzFifoSolver(az_aware=True),
             packers.az_aware_tightly_pack,
+        ),
+        (
+            "queue/single-az-minimal-fragmentation",
+            TpuSingleAzFifoSolver(inner_policy="minimal-fragmentation"),
+            packers.single_az_minimal_fragmentation,
         ),
     ]
     n_nodes = len(metadata)
