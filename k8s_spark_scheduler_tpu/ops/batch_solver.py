@@ -282,11 +282,13 @@ def min_frag_counts(cap: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
             good = jnp.sum(jnp.where(dd >= mid, dc, 0)) >= k
             return (jnp.where(good, mid, lo), jnp.where(good, hi, mid - 1))
 
-        # fixed 31 probes cover the full int32 capacity domain.  A
-        # lax.while_loop bounded by max(dd) (~7 probes for real caps)
-        # measures no better and its dynamic trip count inside the queue
-        # scan sends XLA compile time pathological (>10min vs seconds) —
-        # keep the static loop.
+        # fixed 31 probes cover the full int32 capacity domain; this is
+        # the variant measured at 123ms/queue (10k×1k) on TPU.  A
+        # lax.while_loop bounded by max(dd) (~7 probes for real
+        # capacities) is a candidate speedup but is unmeasured on
+        # hardware — an earlier "pathological compile" diagnosis against
+        # it was traced to a wedged TPU relay plus the sitecustomize
+        # env-override trap, not the loop construct.
         vstar, _ = lax.fori_loop(
             0, 31, body, (jnp.int32(1), jnp.int32(MF_SENT))
         )
@@ -320,6 +322,29 @@ def min_frag_counts(cap: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(full_ok, counts, jnp.zeros_like(counts))
 
 
+def min_frag_step_counts(carry_avail, feasible, driver_idx, driver, executor, exec_ok, k):
+    """Shared per-step min-frag placement: subtract the driver on its
+    chosen node, run the capacity + drain kernels over the eligible
+    mask, zero when infeasible.  Used by both the plain min-frag queue
+    scan and the single-AZ scan's per-zone solves so capacity-semantics
+    fixes can never diverge between lanes."""
+    n = carry_avail.shape[0]
+    is_drv = (jnp.arange(n, dtype=jnp.int32) == driver_idx) & feasible
+    avail_eff = carry_avail - jnp.where(is_drv[:, None], driver[None, :], 0)
+    mf = min_frag_counts(min_frag_capacity(avail_eff, executor, exec_ok), k)
+    return jnp.where(feasible, mf, jnp.zeros_like(mf))
+
+
+def mf_sentinel_safe(avail) -> bool:
+    """Host-side guard shared by the fused min-frag lanes: every scaled
+    availability value must stay below MF_SENT − 1 so a real capacity
+    can never collide with the unbounded-capacity sentinel."""
+    import numpy as _np
+
+    a = _np.asarray(avail)
+    return a.size == 0 or int(a.max()) <= MF_SENT - 1
+
+
 @functools.partial(jax.jit, static_argnames=("with_placements",))
 def solve_queue_min_frag(
     avail: jnp.ndarray,      # [N, 3] int32
@@ -344,10 +369,9 @@ def solve_queue_min_frag(
         solve = solve_app(carry_avail, driver_rank, exec_ok, driver, executor, k)
         feasible = solve.feasible & valid
         didx = jnp.where(feasible, solve.driver_idx, jnp.int32(n))
-        is_drv = jnp.arange(n, dtype=jnp.int32) == didx
-        avail_eff = carry_avail - jnp.where(is_drv[:, None], driver[None, :], 0)
-        mf = min_frag_counts(min_frag_capacity(avail_eff, executor, exec_ok), k)
-        mf = jnp.where(feasible, mf, jnp.zeros_like(mf))
+        mf = min_frag_step_counts(
+            carry_avail, feasible, didx, driver, executor, exec_ok, k
+        )
         mf_solve = AppSolve(
             feasible=feasible, driver_idx=didx, exec_counts=mf, exec_capacity=mf
         )
@@ -424,6 +448,11 @@ def _zone_score(
     th_mem: jnp.ndarray,       # [N] int32 = ceil(sched_mem_bytes / scale_mem)
     scale_cpu: jnp.ndarray,    # [] int32
     scale_gpu: jnp.ndarray,    # [] int32
+    eff_counts: jnp.ndarray | None = None,  # [N] int32 — reservation-side
+    # counts for the efficiency numerators when they differ from the
+    # occurrence weights (min-frag strict parity: the no-write-back
+    # quirk makes efficiencies see only the driver, while occurrences
+    # still weight every executor placement)
 ):
     """(Q, nonzero): the fixed-point zone score for one zone's packing and
     the exact S > 0 indicator (efficiency.go:80-156 semantics: value()
@@ -433,7 +462,8 @@ def _zone_score(
     is_driver = (jnp.arange(n, dtype=jnp.int32) == solve.driver_idx) & solve.feasible
     counts = solve.exec_counts
     w = counts + is_driver.astype(jnp.int32)
-    new = counts[:, None] * executor[None, :] + jnp.where(
+    res_counts = counts if eff_counts is None else eff_counts
+    new = res_counts[:, None] * executor[None, :] + jnp.where(
         is_driver[:, None], driver[None, :], 0
     )
     m = carry_avail - new  # scaled availability net of this packing; ≥ 0 where w > 0
@@ -464,7 +494,7 @@ def _zone_score(
     return score, nonzero
 
 
-@functools.partial(jax.jit, static_argnames=("az_aware",))
+@functools.partial(jax.jit, static_argnames=("az_aware", "minfrag", "strict"))
 def solve_queue_single_az(
     avail: jnp.ndarray,        # [N, 3] int32
     driver_rank: jnp.ndarray,  # [N] int32
@@ -481,14 +511,20 @@ def solve_queue_single_az(
     scale_cpu: jnp.ndarray,    # [] int32
     scale_gpu: jnp.ndarray,    # [] int32
     az_aware: bool = False,
+    minfrag: bool = False,
+    strict: bool = True,
 ) -> ZoneQueueSolve:
     """Whole-FIFO-queue single-AZ gang solve in ONE dispatch
     (single_az.go:23-97 × resource.go:224-262): scan apps in order; each
-    step solves every zone (inner tightly-pack), scores feasible zones
-    with the fixed-point efficiency comparator (see EFF_SHIFT), applies
-    the strict-improvement choice in zone order, optionally falls back
-    to a cross-zone pack (az_aware_pack_tightly.go:27-38), and carries
+    step solves every zone (inner tightly-pack, or the min-frag kernel
+    when minfrag=True — single-az-minimal-fragmentation semantics, with
+    driver-only efficiency numerators under strict parity), scores
+    feasible zones with the fixed-point efficiency comparator (see
+    EFF_SHIFT), applies the strict-improvement choice in zone order,
+    optionally falls back to a cross-zone pack
+    (az_aware_pack_tightly.go:27-38; no min-frag variant), and carries
     availability with the reference's subtraction quirk."""
+    assert not (az_aware and minfrag)
     n = avail.shape[0]
     z_count = zone_masks.shape[0]
 
@@ -496,14 +532,12 @@ def solve_queue_single_az(
         driver, executor, k, valid = app
         band = 2 * (k + 1) + 2
 
-        best_q = jnp.int32(0)
-        best_zone = jnp.int32(-1)
-        uncertain = jnp.zeros((), bool)
-        chosen_counts = jnp.zeros((n,), jnp.int32)
-        chosen_didx = jnp.int32(n)
-
-        for z in range(z_count):
-            mask = zone_masks[z]
+        def zone_solve(mask):
+            """One zone's packing + fixed-point score.  vmapped over
+            zones so the scan body holds exactly ONE fori_loop — several
+            per step (an unrolled zone loop around the min-frag kernel)
+            sends XLA compile time pathological, like the while_loop
+            note on min_frag_counts."""
             solve = solve_app(
                 carry_avail,
                 jnp.where(mask, driver_rank, BIG),
@@ -512,11 +546,37 @@ def solve_queue_single_az(
                 executor,
                 k,
             )
+            if minfrag:
+                mf = min_frag_step_counts(
+                    carry_avail, solve.feasible, solve.driver_idx,
+                    driver, executor, exec_ok & mask, k,
+                )
+                solve = AppSolve(
+                    feasible=solve.feasible,
+                    driver_idx=solve.driver_idx,
+                    exec_counts=mf,
+                    exec_capacity=solve.exec_capacity,
+                )
+                eff_counts = jnp.zeros_like(mf) if strict else mf
+            else:
+                eff_counts = None
             score, nz = _zone_score(
                 carry_avail, solve, driver, executor,
                 s_cpu_milli, s_gpu_milli, inv_mem, th_mem, scale_cpu, scale_gpu,
+                eff_counts=eff_counts,
             )
-            f = solve.feasible
+            return solve.feasible, solve.driver_idx, solve.exec_counts, score, nz
+
+        zf, zdidx, zcounts, zscore, znz = jax.vmap(zone_solve)(zone_masks)
+
+        best_q = jnp.int32(0)
+        best_zone = jnp.int32(-1)
+        uncertain = jnp.zeros((), bool)
+        chosen_counts = jnp.zeros((n,), jnp.int32)
+        chosen_didx = jnp.int32(n)
+
+        for z in range(z_count):
+            f, score, nz = zf[z], zscore[z], znz[z]
             first = best_zone < 0
             better = f & jnp.where(first, nz, score > best_q)
             uncertain = uncertain | (
@@ -524,8 +584,8 @@ def solve_queue_single_az(
             )
             best_q = jnp.where(better, score, best_q)
             best_zone = jnp.where(better, jnp.int32(z), best_zone)
-            chosen_counts = jnp.where(better, solve.exec_counts, chosen_counts)
-            chosen_didx = jnp.where(better, solve.driver_idx, chosen_didx)
+            chosen_counts = jnp.where(better, zcounts[z], chosen_counts)
+            chosen_didx = jnp.where(better, zdidx[z], chosen_didx)
 
         if az_aware:
             cross = solve_app(carry_avail, driver_rank, exec_ok, driver, executor, k)

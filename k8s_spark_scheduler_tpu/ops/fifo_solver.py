@@ -241,10 +241,13 @@ class TpuFifoSolver:
 
         evenly = self.assignment_policy == "distribute-evenly"
         minfrag = self.assignment_policy == "minimal-fragmentation"
-        if minfrag and problem.avail.size and int(problem.avail.max()) > 2**31 - 3:
-            # a real capacity could collide with the device kernel's
-            # unbounded-capacity sentinel (batch_solver.MF_SENT)
-            return FifoOutcome(supported=False)
+        if minfrag:
+            from .batch_solver import mf_sentinel_safe
+
+            if not mf_sentinel_safe(problem.avail):
+                # a real capacity could collide with the device kernel's
+                # unbounded-capacity sentinel (batch_solver.MF_SENT)
+                return FifoOutcome(supported=False)
         n_earlier = len(earlier_apps)
 
         if n_earlier > 0:
@@ -455,11 +458,12 @@ class TpuSingleAzFifoSolver:
         # inner_policy "minimal-fragmentation" gives the
         # single-az-minimal-fragmentation semantics: zone feasibility and
         # driver choice are shared with tightly (work-conserving drain),
-        # placements come from the host bisect on the carried scaled
-        # availability, and the zone choice sees driver-only reserved
-        # under strict parity (the reference's no-write-back quirk).  It
-        # always runs the host zone-choice lane (the fused kernel packs
-        # tightly); az_aware has no min-frag variant in the reference.
+        # placements come from the min-frag kernel / host bisect, and the
+        # zone choice sees driver-only reserved under strict parity (the
+        # reference's no-write-back quirk).  Its fused one-dispatch lane
+        # is the XLA scan with minfrag=True (the pallas kernel packs
+        # tightly only); az_aware has no min-frag variant in the
+        # reference.
         assert not (az_aware and inner_policy == "minimal-fragmentation")
         self.az_aware = az_aware
         self.backend = backend
@@ -591,16 +595,19 @@ class TpuSingleAzFifoSolver:
         # None = no queue pass ran (empty queue); "fused"/"host" report
         # which lane actually processed earlier drivers
         self.last_path = None
-        # the fused kernels pack tightly and score full reservations —
-        # both wrong for the min-frag inner policy (bisect placements,
-        # driver-only strict scores): it must take the host lane
-        if n_earlier > 0 and not minfrag_inner:
+        # min-frag inner: the fused XLA scan runs the min-frag kernel per
+        # zone (driver-only strict scores); the pallas kernel packs
+        # tightly only, so it never serves this policy.
+        from .batch_solver import mf_sentinel_safe
+
+        mf_fused_ok = not minfrag_inner or mf_sentinel_safe(problem.avail)
+        if n_earlier > 0 and mf_fused_ok:
             eff_inputs = _fused_efficiency_inputs(cluster, problem)
             if eff_inputs is not None:
                 s_cpu, s_gpu, inv_m, th_m, scale_c, scale_g = eff_inputs
                 queue_valid = problem.app_valid.copy()
                 queue_valid[n_earlier:] = False
-                if self._use_pallas():
+                if self._use_pallas() and not minfrag_inner:
                     from .pallas_queue import pallas_solve_queue_single_az
 
                     # disjoint zone masks → one zone index per node
@@ -655,6 +662,8 @@ class TpuSingleAzFifoSolver:
                         jnp.int32(scale_c),
                         jnp.int32(scale_g),
                         az_aware=self.az_aware,
+                        minfrag=minfrag_inner,
+                        strict=self.strict_reference_parity,
                     )
                 if not bool(np.asarray(out.uncertain)[:n_earlier].any()):
                     # the one-dispatch lane's answer is certain — it is

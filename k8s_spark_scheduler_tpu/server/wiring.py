@@ -138,7 +138,28 @@ class Server:
                             row, row, jnp.int32(0),
                         )
                     if single_az and saz_minfrag:
-                        pass  # no fused queue kernel for this policy
+                        # the fused min-frag single-AZ scan (XLA only);
+                        # strict is a static jit argname, so warm the
+                        # configured compat mode
+                        strict = getattr(
+                            self.extender.binpacker.queue_solver,
+                            "strict_reference_parity",
+                            True,
+                        )
+                        solve_queue_single_az(
+                            avail, rank, eok,
+                            jnp.zeros((warm_zones, nb), bool),
+                            *apps,
+                            jnp.zeros((nb,), jnp.int32),
+                            jnp.zeros((nb,), jnp.int32),
+                            jnp.zeros((nb,), jnp.float32),
+                            jnp.zeros((nb,), jnp.int32),
+                            jnp.int32(1),
+                            jnp.int32(1),
+                            az_aware=False,
+                            minfrag=True,
+                            strict=strict,
+                        )
                     elif single_az:
                         az_aware = name.endswith("az-aware")
                         if use_pallas:

@@ -661,6 +661,7 @@ def test_single_az_min_frag_fifo_solver_parity(strict):
         inner_policy="minimal-fragmentation",
         strict_reference_parity=strict,
     )
+    fused_served = 0
     for trial in range(40):
         metadata = random_cluster(rng, rng.randint(2, 16))
         driver_order, executor_order = orders_for(metadata, rng)
@@ -680,6 +681,7 @@ def test_single_az_min_frag_fifo_solver_parity(strict):
         )
         assert outcome.supported
         assert outcome.earlier_ok == expected_ok, f"trial {trial}"
+        fused_served += solver.last_path == "fused"
         if expected_ok:
             assert outcome.result.has_capacity == expected.has_capacity, f"trial {trial}"
             if expected.has_capacity:
@@ -687,6 +689,9 @@ def test_single_az_min_frag_fifo_solver_parity(strict):
                 assert (
                     outcome.result.executor_nodes == expected.executor_nodes
                 ), f"trial {trial}"
+    # the one-dispatch lane must actually serve these queues — decisions
+    # matching via a silent host-lane fallback would not pin the kernel
+    assert fused_served >= 30, fused_served
 
 
 def test_extender_tpu_batch_single_az_min_frag_matches_host():
