@@ -1,0 +1,167 @@
+"""TPU evidence sentinel (VERDICT r2 #1).
+
+The dev TPU relay wedges for long stretches; both prior rounds ended
+with the relay dead so the driver's round-end ``bench.py`` run recorded
+only the CPU fallback, and every real TPU measurement lived in prose.
+This sentinel makes TPU evidence *durable*: it probes the relay on a
+period, and the FIRST time the backend comes up it runs the full bench
+and immediately commits a timestamped artifact —
+
+  - ``BENCH_TPU_<utc>.json``  (the parsed result + run metadata)
+  - ``logs/bench_tpu_<utc>.log``  (the raw bench stdout+stderr)
+
+— via ``git commit -- <those paths>`` so a later wedge cannot erase the
+evidence.  Run it in the background for the whole round:
+
+    python tools/tpu_sentinel.py >> logs/tpu_sentinel.log 2>&1 &
+
+Exits after the first committed success unless ``--keep-running``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from k8s_spark_scheduler_tpu.utils.tpuprobe import probe_default_backend
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[sentinel {stamp}] {msg}", flush=True)
+
+
+def run_bench(budget_s: float, log_path: str) -> dict | None:
+    """Run bench.py with stdout+stderr sunk straight into ``log_path``
+    (a regular file — no pipe to block on if a wedged TPU worker
+    outlives bench itself); returns the parsed result dict when the
+    headline came from the TPU worker.
+
+    Wedge/overrun survival is run_detached's poll-loop kill.  Even on a
+    kill we still parse whatever reached the log: the TPU headline
+    prints before bench's unbounded secondary CPU configs, so a late
+    overrun must not discard already-captured evidence."""
+    from k8s_spark_scheduler_tpu.utils.tpuprobe import run_detached
+
+    os.environ["BENCH_TPU_BUDGET_S"] = str(budget_s)
+    with open(log_path, "wb") as lf:
+        code = run_detached(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            budget_s + 600.0,
+            lf,
+            lf,
+        )
+    with open(log_path, "rb") as lf:
+        text = lf.read().decode(errors="replace")
+    if code is None:
+        log("bench overran its deadline; killed (parsing partial log)")
+    elif code != 0:
+        log(f"bench exited rc={code} (parsing partial log)")
+    # the TPU path is authoritative only when the worker's pallas
+    # diagnostics are present (CPU fallback prints backend=xla-scan)
+    if "backend=pallas" not in text:
+        log("bench output has no pallas headline; not an artifact")
+        return None
+    result = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                result = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if result is None:
+        log("bench printed no parseable result line")
+        return None
+    diags = [l for l in text.splitlines() if l.startswith("#")]
+    return {"result": result, "diagnostics": diags}
+
+
+def git_commit_paths(paths: list[str], message: str) -> bool:
+    """Commit exactly ``paths`` (working-tree content), retrying around
+    a possibly-busy index; other staged work is left untouched."""
+    for attempt in range(8):
+        add = subprocess.run(
+            ["git", "-C", REPO, "add", "--", *paths],
+            capture_output=True, text=True,
+        )
+        if add.returncode == 0:
+            commit = subprocess.run(
+                ["git", "-C", REPO, "commit", "-m", message, "--", *paths],
+                capture_output=True, text=True,
+            )
+            if commit.returncode == 0:
+                log(f"committed: {commit.stdout.strip().splitlines()[0]}")
+                return True
+            log(f"git commit failed (attempt {attempt}): {commit.stderr.strip()[-200:]}")
+        else:
+            log(f"git add failed (attempt {attempt}): {add.stderr.strip()[-200:]}")
+        time.sleep(3.0)
+    return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between relay probes")
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--bench-budget", type=float, default=900.0,
+                    help="BENCH_TPU_BUDGET_S for the evidence run")
+    ap.add_argument("--keep-running", action="store_true",
+                    help="keep probing after the first committed artifact")
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    args = ap.parse_args()
+
+    os.makedirs(os.path.join(REPO, "logs"), exist_ok=True)
+    stop_at = time.monotonic() + args.max_hours * 3600.0
+    probe_n = 0
+    while time.monotonic() < stop_at:
+        probe_n += 1
+        backend = probe_default_backend(args.probe_timeout)
+        if backend and "tpu" in backend:
+            log(f"probe {probe_n}: relay ALIVE (backend={backend}); running bench")
+            ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%Y%m%dT%H%M%SZ"
+            )
+            log_rel = f"logs/bench_tpu_{ts}.log"
+            out = run_bench(args.bench_budget, os.path.join(REPO, log_rel))
+            if out is not None:
+                art_rel = f"BENCH_TPU_{ts}.json"
+                artifact = {
+                    "timestamp_utc": ts,
+                    "platform": "tpu",
+                    "backend": "pallas",
+                    "probe_backend": backend,
+                    "raw_log": log_rel,
+                    **out,
+                }
+                with open(os.path.join(REPO, art_rel), "w") as f:
+                    json.dump(artifact, f, indent=2)
+                    f.write("\n")
+                ok = git_commit_paths(
+                    [art_rel, log_rel],
+                    f"TPU evidence: p99 "
+                    f"{out['result'].get('value')}ms on live relay ({ts})",
+                )
+                if ok and not args.keep_running:
+                    log("durable TPU artifact committed; sentinel done")
+                    return 0
+            else:
+                log("relay answered the probe but the bench run failed; retrying")
+        else:
+            log(f"probe {probe_n}: relay wedged/not-tpu (backend={backend})")
+        time.sleep(args.interval)
+    log("sentinel window elapsed without a committed TPU artifact")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
