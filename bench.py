@@ -63,6 +63,23 @@ _RESULT_PREFIX = "BENCH_RESULT_JSON "
 _EXIT_NOT_TPU = 3
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache shared across bench processes:
+    fresh-subprocess TPU attempts (and re-runs after a relay wedge) hit
+    the cache instead of paying the 3-20s compile every time."""
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "BENCH_JAX_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as err:  # cache is an optimization, never a failure
+        print(f"# compile cache unavailable: {err}", file=sys.stderr)
+
+
 def build_problem():
     from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
     from k8s_spark_scheduler_tpu.ops.tensorize import (
@@ -203,6 +220,7 @@ def tpu_worker() -> int:
     import jax
     import jax.numpy as jnp
 
+    _enable_compile_cache()
     backend = jax.default_backend()  # ← the call that wedges on a bad relay
     if "tpu" not in backend:
         print(f"# worker: default backend is {backend!r}, not tpu", file=sys.stderr)
@@ -504,6 +522,7 @@ def cpu_fallback() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
 
     from k8s_spark_scheduler_tpu.ops.batch_solver import solve_app, solve_queue
 
@@ -511,6 +530,13 @@ def cpu_fallback() -> None:
     # same operation as the TPU worker: queue over the earlier apps,
     # separate decode for the current driver
     problem.app_valid[N_APPS - 1] = False
+
+    # the production CPU lane (TpuFifoSolver backend="auto" on a
+    # CPU-only host) is the native C++ queue solver — decision-identical
+    # to the device scan (tests/test_native_fifo.py); it is the honest
+    # fallback headline, with the XLA scan kept as a diagnostic
+    native = _native_cpu_measure(problem)
+
     args = _device_args(problem)
 
     # note: sharding the scan across virtual CPU devices was measured
@@ -531,7 +557,58 @@ def cpu_fallback() -> None:
         return feas, out.avail_after
 
     lat, feasible_count, rtt_s = _measure_chained(one_solve, args, label="xla-scan cpu")
-    _emit(lat, feasible_count, rtt_s, marshal_s, backend="xla-scan")
+    if native is not None:
+        nat_lat, nat_feasible = native
+        _emit(nat_lat, nat_feasible, 0.0, marshal_s, backend="native-cpp")
+    else:
+        _emit(lat, feasible_count, rtt_s, marshal_s, backend="xla-scan")
+
+
+def _native_cpu_measure(problem):
+    """Measure the native C++ queue solver (queue pass + current-driver
+    decode, the TpuFifoSolver CPU-lane program).  Returns (lat_ms array,
+    feasible_count) or None when the toolchain is unavailable."""
+    try:
+        from k8s_spark_scheduler_tpu.native.fifo import (
+            native_fifo_available,
+            solve_app_native,
+            solve_queue_native,
+        )
+
+        if not native_fifo_available():
+            return None
+        last = N_APPS - 1
+
+        def one():
+            feas, _, avail_after = solve_queue_native(
+                problem.avail, problem.driver_rank, problem.exec_ok,
+                problem.driver, problem.executor, problem.count,
+                problem.app_valid, evenly=False,
+            )
+            fb, _db, cb, _caps = solve_app_native(
+                avail_after, problem.driver_rank, problem.exec_ok,
+                problem.driver[last], problem.executor[last],
+                int(problem.count[last]),
+            )
+            return int(feas.sum()) + int(fb and cb.sum() == problem.count[last])
+
+        feasible_count = one()  # warm the code path
+        lat_ms = []
+        for _ in range(max(ROUNDS, 15)):
+            t0 = time.perf_counter()
+            one()
+            lat_ms.append((time.perf_counter() - t0) * 1000.0)
+        lat = np.array(lat_ms)
+        print(
+            f"# [native-cpp cpu] p99={np.percentile(lat, 99):.2f}ms "
+            f"p50={np.percentile(lat, 50):.2f}ms mean={lat.mean():.2f}ms "
+            f"feasible={feasible_count}/{N_APPS}",
+            file=sys.stderr,
+        )
+        return lat, feasible_count
+    except Exception as err:
+        print(f"# native CPU lane unavailable: {err}", file=sys.stderr)
+        return None
 
 
 def main() -> None:

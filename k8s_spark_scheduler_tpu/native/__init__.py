@@ -28,24 +28,34 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
 
 
+def build_native_lib(src: str, lib_path: str, flags: list[str]) -> ctypes.CDLL:
+    """Shared compile-on-first-use machinery for the native libraries:
+    rebuild when the source is newer (a present prebuilt .so with no
+    source alongside is used as-is), always via an atomic tmp+rename so
+    concurrent processes never CDLL-load a partially written file.
+    Raises on failure — callers wrap with their own degrade policy."""
+    stale = not os.path.exists(lib_path) or (
+        os.path.exists(src) and os.path.getmtime(lib_path) < os.path.getmtime(src)
+    )
+    if stale:
+        os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+        tmp = lib_path + f".tmp.{os.getpid()}"
+        subprocess.run(
+            ["g++", *flags, "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, lib_path)
+    return ctypes.CDLL(lib_path)
+
+
 def _build_and_load() -> Optional[ctypes.CDLL]:
     global _lib, _lib_failed
     with _lib_lock:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-                os.makedirs(os.path.dirname(_LIB), exist_ok=True)
-                # atomic build: concurrent processes must never CDLL-load
-                # a partially written file
-                tmp = _LIB + f".tmp.{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
-                    check=True,
-                    capture_output=True,
-                )
-                os.replace(tmp, _LIB)
-            lib = ctypes.CDLL(_LIB)
+            lib = build_native_lib(_SRC, _LIB, ["-O2"])
             lib.snap_create.restype = ctypes.c_void_p
             lib.snap_create.argtypes = [ctypes.c_int64]
             lib.snap_destroy.argtypes = [ctypes.c_void_p]

@@ -1,0 +1,140 @@
+"""ctypes binding for the native FIFO queue solver (native/fifo_solver.cpp).
+
+The host-CPU lane of the batch solver: bit-exact decisions vs
+ops/batch_solver.solve_queue (tightly-pack / distribute-evenly), at
+native speed for deployments without an accelerator.  Build-on-first-use
+with graceful degradation, same pattern as the snapshot maintainer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "fifo_solver.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "_build", "libfifosolver.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+_P = ctypes.c_void_p
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            from . import build_native_lib
+
+            lib = build_native_lib(
+                _SRC,
+                _LIB,
+                [
+                    "-O3", "-march=native", "-funroll-loops",
+                    # IEEE semantics preserved; only errno/trap
+                    # bookkeeping dropped so divpd vectorizes cleanly
+                    "-fno-math-errno", "-fno-trapping-math",
+                ],
+            )
+            lib.fifo_solve_queue.restype = ctypes.c_int
+            lib.fifo_solve_queue.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, _P, _P, _P, _P, _P, _P, _P,
+                ctypes.c_int, _P, _P,
+            ]
+            lib.fifo_solve_app.restype = ctypes.c_int
+            lib.fifo_solve_app.argtypes = [
+                ctypes.c_int64, _P, _P, _P, _P, _P, ctypes.c_int32,
+                _P, _P, _P, _P,
+            ]
+            _lib = lib
+        except Exception:
+            logger.warning(
+                "native fifo solver unavailable; device/XLA lanes only",
+                exc_info=True,
+            )
+            _lib_failed = True
+    return _lib
+
+
+def native_fifo_available() -> bool:
+    return _build_and_load() is not None
+
+
+def _c(arr: np.ndarray) -> ctypes.c_void_p:
+    return arr.ctypes.data_as(_P)
+
+
+def solve_queue_native(
+    avail: np.ndarray,        # [N, 3] int32 (not mutated)
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    drivers: np.ndarray,      # [A, 3] int32
+    executors: np.ndarray,    # [A, 3] int32
+    counts: np.ndarray,       # [A] int32
+    app_valid: np.ndarray,    # [A] bool
+    evenly: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(feasible[A] bool, driver_idx[A] int32, avail_after[N,3] int32) —
+    decision-identical to solve_queue(..., with_placements=False)."""
+    lib = _build_and_load()
+    if lib is None:
+        raise RuntimeError("native fifo solver not available")
+    avail_io = np.ascontiguousarray(avail, dtype=np.int32).copy()
+    rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    drv = np.ascontiguousarray(drivers, dtype=np.int32)
+    exe = np.ascontiguousarray(executors, dtype=np.int32)
+    cnt = np.ascontiguousarray(counts, dtype=np.int32)
+    val = np.ascontiguousarray(app_valid, dtype=np.uint8)
+    nb, na = avail_io.shape[0], drv.shape[0]
+    feas = np.zeros(na, dtype=np.uint8)
+    didx = np.zeros(na, dtype=np.int32)
+    lib.fifo_solve_queue(
+        nb, na, _c(avail_io), _c(rank), _c(eok), _c(drv), _c(exe), _c(cnt),
+        _c(val), int(evenly), _c(feas), _c(didx),
+    )
+    return feas.astype(bool), didx, avail_io
+
+
+def solve_app_native(
+    avail: np.ndarray,        # [N, 3] int32
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    driver: np.ndarray,       # [3] int32
+    executor: np.ndarray,     # [3] int32
+    k: int,
+) -> Tuple[bool, int, np.ndarray, np.ndarray]:
+    """(feasible, driver_idx, exec_counts[N], exec_capacity[N]) —
+    decision-identical to batch_solver.solve_app (tightly-pack fill
+    counts + post-driver-placement capacities)."""
+    lib = _build_and_load()
+    if lib is None:
+        raise RuntimeError("native fifo solver not available")
+    av = np.ascontiguousarray(avail, dtype=np.int32)
+    rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    drv = np.ascontiguousarray(driver, dtype=np.int32)
+    exe = np.ascontiguousarray(executor, dtype=np.int32)
+    nb = av.shape[0]
+    feas = np.zeros(1, dtype=np.uint8)
+    didx = np.zeros(1, dtype=np.int32)
+    counts = np.zeros(nb, dtype=np.int32)
+    caps = np.zeros(nb, dtype=np.int32)
+    lib.fifo_solve_app(
+        nb, _c(av), _c(rank), _c(eok), _c(drv), _c(exe),
+        ctypes.c_int32(int(k)), _c(feas), _c(didx), _c(counts), _c(caps),
+    )
+    return bool(feas[0]), int(didx[0]), counts, caps

@@ -1,0 +1,289 @@
+// Native FIFO queue gang solver — the host-CPU lane of the batch
+// solver (ops/batch_solver.py::solve_queue), for deployments without a
+// TPU and for the bench's CPU fallback.
+//
+// Replicates the device solver's decisions BIT-EXACTLY (same capacity
+// rule as reference capacity.go:36-75 with the negative-availability
+// short-circuit; same first-priority driver choice binpack.go:60-87;
+// same usage-subtraction quirk sparkpods.go:139-146): the parity suite
+// (tests/test_native_fifo.py) runs the randomized differential against
+// solve_queue for both tightly-pack and distribute-evenly.
+//
+// Design notes for the one-core host this runs on:
+//  - per app, per-node capacity needs a floor-division per nonzero
+//    executor dimension; int32/int32 division done in double is exact
+//    (|numerator| < 2^31 and numerator = q*den ⟹ representable; a
+//    non-integer quotient is ≥ 1/den > ulp away from any integer since
+//    num·den < 2^52) and, unlike integer division, vectorizes.
+//  - driver choice walks a rank-sorted candidate list (built once per
+//    queue: driver_rank is constant) and computes the with-driver
+//    capacity lazily — almost always a handful of probes instead of a
+//    second full N-vector pass.
+//  - all int32 arithmetic wraps exactly like XLA's (unsigned ops).
+//
+// C ABI via ctypes (k8s_spark_scheduler_tpu/native/fifo.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+constexpr int kDims = 3;
+constexpr int32_t kBig = 2147483647;  // batch_solver.BIG
+
+inline int32_t wrap_sub(int32_t a, int32_t b) {
+  return static_cast<int32_t>(static_cast<uint32_t>(a) -
+                              static_cast<uint32_t>(b));
+}
+
+// Per-node executor capacity clamped to [0, k] (capacity.go:36-75 via
+// batch_solver.node_capacity): zero-requirement dim is unbounded unless
+// availability is negative; any value ≤ 0 clips to 0, so truncating
+// division equals the device kernel's floor division after the clip.
+inline int32_t clamped_cap(const int32_t* a, const int32_t* e, int32_t k) {
+  int32_t cap = k;
+  for (int j = 0; j < kDims; ++j) {
+    int32_t c;
+    if (e[j] == 0) {
+      c = a[j] >= 0 ? kBig : 0;
+    } else if (a[j] <= 0) {
+      c = 0;
+    } else {
+      c = static_cast<int32_t>(static_cast<double>(a[j]) /
+                               static_cast<double>(e[j]));
+    }
+    cap = std::min(cap, c);
+  }
+  return std::max(cap, 0);
+}
+
+// Branchless capacity pass over column planes, specialized per app on
+// which executor dims are nonzero (the dim pattern is constant across
+// the whole node axis, so hoisting it turns the inner loop into pure
+// cvtdq2pd/divpd/cvttpd2dq + min/max SIMD).  Double division of int32
+// by int32 is exact: an integer quotient is representable and hit
+// exactly; a non-integer one sits ≥ 1/den > ulp(q) from any integer
+// (num·den < 2^52).  Negative numerators give values ≤ 0 that the final
+// [0, k] clamp zeroes, matching the device kernel's floor + clip.
+template <bool E0, bool E1, bool E2>
+int64_t cap_pass(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+                 const uint8_t* exec_ok, int64_t nb, double de0, double de1,
+                 double de2, int32_t k, int32_t* cap) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = k;
+    if (E0) c = std::min(c, static_cast<int32_t>(a0[i] / de0));
+    if (E1) c = std::min(c, static_cast<int32_t>(a1[i] / de1));
+    if (E2) c = std::min(c, static_cast<int32_t>(a2[i] / de2));
+    // zero-requirement dims bound capacity only when already overdrawn
+    if (!E0) c = a0[i] >= 0 ? c : 0;
+    if (!E1) c = a1[i] >= 0 ? c : 0;
+    if (!E2) c = a2[i] >= 0 ? c : 0;
+    c = exec_ok[i] ? c : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+using CapPassFn = int64_t (*)(const int32_t*, const int32_t*, const int32_t*,
+                              const uint8_t*, int64_t, double, double, double,
+                              int32_t, int32_t*);
+
+CapPassFn select_cap_pass(const int32_t* e) {
+  static constexpr CapPassFn kTable[8] = {
+      cap_pass<false, false, false>, cap_pass<false, false, true>,
+      cap_pass<false, true, false>,  cap_pass<false, true, true>,
+      cap_pass<true, false, false>,  cap_pass<true, false, true>,
+      cap_pass<true, true, false>,   cap_pass<true, true, true>,
+  };
+  int idx = (e[0] != 0 ? 4 : 0) | (e[1] != 0 ? 2 : 0) | (e[2] != 0 ? 1 : 0);
+  return kTable[idx];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Whole-FIFO-queue solve (batch_solver.solve_queue semantics,
+// with_placements=False): scan apps in order carrying availability.
+//   avail_io      [nb*3] int32 row-major — updated in place to the
+//                 post-queue availability
+//   driver_rank   [nb] int32 (kBig = not a driver candidate)
+//   exec_ok       [nb] uint8
+//   drivers/executors [na*3] int32, counts [na] int32, app_valid [na] u8
+//   evenly        0 = tightly-pack fill, 1 = distribute-evenly mask
+//   out_feasible  [na] uint8
+//   out_driver_idx[na] int32 (= nb when infeasible)
+// Scratch buffers are internal; returns 1 (always succeeds).
+int fifo_solve_queue(int64_t nb, int64_t na, int32_t* avail_io,
+                     const int32_t* driver_rank, const uint8_t* exec_ok,
+                     const int32_t* drivers, const int32_t* executors,
+                     const int32_t* counts, const uint8_t* app_valid,
+                     int evenly, uint8_t* out_feasible,
+                     int32_t* out_driver_idx) {
+  // rank-sorted driver candidates, built once (ranks are unique)
+  std::vector<int32_t> cand;
+  cand.reserve(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    if (driver_rank[i] < kBig) cand.push_back(static_cast<int32_t>(i));
+  }
+  std::sort(cand.begin(), cand.end(), [&](int32_t x, int32_t y) {
+    return driver_rank[x] < driver_rank[y];
+  });
+
+  // availability as column planes for the SIMD capacity pass; written
+  // back to the row-major buffer at the end
+  std::vector<int32_t> a0(nb), a1(nb), a2(nb), cap(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    a0[i] = avail_io[i * kDims + 0];
+    a1[i] = avail_io[i * kDims + 1];
+    a2[i] = avail_io[i * kDims + 2];
+  }
+
+  for (int64_t ai = 0; ai < na; ++ai) {
+    const int32_t* d = drivers + ai * kDims;
+    const int32_t* e = executors + ai * kDims;
+    const int32_t k = counts[ai];
+    out_feasible[ai] = 0;
+    out_driver_idx[ai] = static_cast<int32_t>(nb);
+    if (!app_valid[ai]) continue;
+
+    // pass 1: per-node capacity + total S (branchless, dim-specialized)
+    const double de0 = e[0] ? e[0] : 1.0, de1 = e[1] ? e[1] : 1.0,
+                 de2 = e[2] ? e[2] : 1.0;
+    int64_t total = select_cap_pass(e)(a0.data(), a1.data(), a2.data(),
+                                       exec_ok, nb, de0, de1, de2, k,
+                                       cap.data());
+
+    // driver choice: first rank-ordered candidate that fits and leaves
+    // total capacity ≥ k with the driver subtracted from its node.
+    // (For fitting nodes avail−driver stays in [0, avail], so capacity
+    // can only shrink and total_d ≤ total — the total < k early-out is
+    // exact.)
+    int32_t didx = -1;
+    int32_t capd = 0;
+    if (total >= k) {
+      for (int32_t i : cand) {
+        int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+        if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+        int32_t am[kDims];
+        for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+        int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+        if (total - cap[i] + cwd >= k) {
+          didx = i;
+          capd = cwd;
+          break;
+        }
+      }
+    }
+    if (didx < 0) continue;
+
+    out_feasible[ai] = 1;
+    out_driver_idx[ai] = didx;
+
+    // usage subtraction (sparkpods.go:139-146 quirk): ONE executor's
+    // worth per hosting node; the driver row on its node unless that
+    // node also hosts executors
+    auto sub_exec = [&](int64_t i) {
+      a0[i] = wrap_sub(a0[i], e[0]);
+      a1[i] = wrap_sub(a1[i], e[1]);
+      a2[i] = wrap_sub(a2[i], e[2]);
+    };
+    bool driver_hosts_exec = false;
+    if (evenly) {
+      // hosting nodes = first k capacity-bearing nodes in node order
+      int32_t placed = 0;
+      for (int64_t i = 0; i < nb && placed < k; ++i) {
+        int32_t c = (i == didx) ? capd : cap[i];
+        if (c <= 0) continue;
+        ++placed;
+        if (i == didx) driver_hosts_exec = true;
+        sub_exec(i);
+      }
+    } else {
+      // tightly-pack: greedy fill in node order until k executors sit
+      int64_t cum = 0;
+      for (int64_t i = 0; i < nb && cum < k; ++i) {
+        int32_t c = (i == didx) ? capd : cap[i];
+        if (c <= 0) continue;
+        cum += c;
+        if (i == didx) driver_hosts_exec = true;
+        sub_exec(i);
+      }
+    }
+    if (!driver_hosts_exec) {
+      a0[didx] = wrap_sub(a0[didx], d[0]);
+      a1[didx] = wrap_sub(a1[didx], d[1]);
+      a2[didx] = wrap_sub(a2[didx], d[2]);
+    }
+  }
+  for (int64_t i = 0; i < nb; ++i) {
+    avail_io[i * kDims + 0] = a0[i];
+    avail_io[i * kDims + 1] = a1[i];
+    avail_io[i * kDims + 2] = a2[i];
+  }
+  return 1;
+}
+
+// Single-app solve against a fixed availability (batch_solver.solve_app
+// semantics): fills out_exec_counts [nb] with the tightly-pack fill
+// counts and out_caps [nb] with the post-driver-placement capacities
+// (AppSolve.exec_capacity — the distribute-evenly decode consumes
+// these; both zeroed when infeasible).  Availability is NOT mutated.
+int fifo_solve_app(int64_t nb, const int32_t* avail,
+                   const int32_t* driver_rank, const uint8_t* exec_ok,
+                   const int32_t* driver, const int32_t* executor,
+                   int32_t k, uint8_t* out_feasible, int32_t* out_driver_idx,
+                   int32_t* out_exec_counts, int32_t* out_caps) {
+  *out_feasible = 0;
+  *out_driver_idx = static_cast<int32_t>(nb);
+  for (int64_t i = 0; i < nb; ++i) out_exec_counts[i] = 0;
+  for (int64_t i = 0; i < nb; ++i) out_caps[i] = 0;
+
+  std::vector<int32_t> cap(nb);
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = exec_ok[i] ? clamped_cap(avail + i * kDims, executor, k) : 0;
+    cap[i] = c;
+    total += c;
+  }
+  int32_t best_rank = kBig;
+  int32_t didx = -1;
+  int32_t capd = 0;
+  if (total >= k) {
+    for (int64_t i = 0; i < nb; ++i) {
+      if (driver_rank[i] >= best_rank) continue;
+      const int32_t* a = avail + i * kDims;
+      if (a[0] < driver[0] || a[1] < driver[1] || a[2] < driver[2]) continue;
+      int32_t am[kDims];
+      for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], driver[j]);
+      int32_t cwd = exec_ok[i] ? clamped_cap(am, executor, k) : 0;
+      if (total - cap[i] + cwd >= k) {
+        best_rank = driver_rank[i];
+        didx = static_cast<int32_t>(i);
+        capd = cwd;
+      }
+    }
+  }
+  if (didx < 0) return 1;
+  *out_feasible = 1;
+  *out_driver_idx = didx;
+  cap[didx] = capd;
+  int64_t cum = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    out_caps[i] = cap[i];
+    if (cum < k) {
+      int64_t take = std::min<int64_t>(cap[i], k - cum);
+      if (take > 0) {
+        out_exec_counts[i] = static_cast<int32_t>(take);
+        cum += take;
+      }
+    }
+  }
+  return 1;
+}
+
+}  // extern "C"
