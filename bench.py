@@ -288,15 +288,19 @@ def tpu_worker() -> int:
 
 
 def _min_frag_diag(problem, rtt_s: float) -> None:
-    """Secondary diagnostic: the fused minimal-fragmentation FIFO scan
-    (batch_solver.solve_queue_min_frag — value-class binary search +
-    masked prefix sums per step, no sort) on the same snapshot: the
-    min-frag policy's whole-queue cost in ONE dispatch (stderr only)."""
+    """Secondary diagnostic: the minimal-fragmentation whole-queue pass —
+    the pallas VMEM kernel (the production TPU lane,
+    pallas_solve_queue_min_frag) and the fused XLA scan
+    (solve_queue_min_frag, the comparison point: 123ms/queue in r02) on
+    the same snapshot (stderr only)."""
     try:
         import jax
         import jax.numpy as jnp
 
         from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue_min_frag
+        from k8s_spark_scheduler_tpu.ops.pallas_queue import (
+            pallas_solve_queue_min_frag,
+        )
 
         rest = (
             jnp.asarray(problem.driver_rank),
@@ -306,29 +310,44 @@ def _min_frag_diag(problem, rtt_s: float) -> None:
             jnp.asarray(problem.count),
             jnp.asarray(problem.app_valid),
         )
-        diag_chain = 2
-
-        @functools.partial(jax.jit, static_argnames=("chain",))
-        def chained(a, chain=diag_chain):
-            tot = jnp.int32(0)
-            for _ in range(chain):
-                out = solve_queue_min_frag(a, *rest, with_placements=False)
-                tot = tot + jnp.sum(out.feasible)
-                a = out.avail_after
-            return tot
-
         a0 = jnp.asarray(problem.avail)
-        int(chained(a0))  # compile
-        lat = []
-        for _ in range(6):
+
+        def measure(label, one, chain):
+            @functools.partial(jax.jit, static_argnames=("c",))
+            def chained(a, c=chain):
+                tot = jnp.int32(0)
+                for _ in range(c):
+                    feas, a = one(a)
+                    tot = tot + jnp.sum(feas)
+                return tot
+
             t0 = time.perf_counter()
-            int(chained(a0))
-            lat.append(max(time.perf_counter() - t0 - rtt_s, 0.0) / diag_chain * 1000.0)
-        print(
-            f"# min-frag whole-queue (fused scan): "
-            f"median={float(np.median(lat)):.1f}ms/queue",
-            file=sys.stderr,
-        )
+            int(chained(a0))  # compile
+            compile_s = time.perf_counter() - t0
+            lat = []
+            for _ in range(6):
+                t0 = time.perf_counter()
+                int(chained(a0))
+                lat.append(
+                    max(time.perf_counter() - t0 - rtt_s, 0.0) / chain * 1000.0
+                )
+            print(
+                f"# min-frag whole-queue ({label}): "
+                f"median={float(np.median(lat)):.1f}ms/queue "
+                f"compile={compile_s:.1f}s",
+                file=sys.stderr,
+            )
+
+        def pallas_one(a):
+            feas, _, a2 = pallas_solve_queue_min_frag(a, *rest)
+            return feas, a2
+
+        def xla_one(a):
+            out = solve_queue_min_frag(a, *rest, with_placements=False)
+            return out.feasible, out.avail_after
+
+        measure("pallas kernel", pallas_one, chain=4)
+        measure("fused scan", xla_one, chain=2)
     except Exception as err:
         print(f"# min-frag diagnostic failed: {err}", file=sys.stderr)
 
@@ -392,8 +411,32 @@ def _single_az_diag(problem, rtt_s: float) -> None:
             file=sys.stderr,
         )
 
-        # the single-az minimal-fragmentation fused scan (XLA; zone
-        # min-frag kernels + driver-only strict scores)
+        # the single-az minimal-fragmentation pass: pallas kernel (the
+        # production TPU lane) vs the fused XLA scan
+        mf_chain = 2
+
+        @functools.partial(jax.jit, static_argnames=("chain",))
+        def mf_pallas_chained(a, chain=mf_chain):
+            tot = jnp.int32(0)
+            for _ in range(chain):
+                feas, _z, _d, unc, a = pallas_solve_queue_single_az(
+                    a, *rest, n_zones=3, az_aware=False, minfrag=True, strict=True
+                )
+                tot = tot + jnp.sum(feas) + jnp.sum(unc)
+            return tot
+
+        int(mf_pallas_chained(a0))  # compile
+        lat = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            int(mf_pallas_chained(a0))
+            lat.append(max(time.perf_counter() - t0 - rtt_s, 0.0) / mf_chain * 1000.0)
+        print(
+            f"# single-az min-frag whole-queue (pallas, 3 zones): "
+            f"median={float(np.median(lat)):.1f}ms/queue",
+            file=sys.stderr,
+        )
+
         from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue_single_az
 
         nb = problem.avail.shape[0]
@@ -410,7 +453,6 @@ def _single_az_diag(problem, rtt_s: float) -> None:
             jnp.int32(1000),
             jnp.int32(1000),
         )
-        mf_chain = 2
 
         @functools.partial(jax.jit, static_argnames=("chain",))
         def mf_chained(a, chain=mf_chain):

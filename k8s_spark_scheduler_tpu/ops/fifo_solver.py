@@ -208,8 +208,9 @@ class TpuFifoSolver:
     the benched cost (queue pass + one O(N) decode solve for the
     current driver's placements).  The native lane
     (native/fifo_solver.cpp) serves accelerator-less deployments with
-    the same decisions at ~8× the XLA-scan speed; minimal-fragmentation
-    stays on the XLA scan."""
+    the same decisions at ~8× the XLA-scan speed (tightly/evenly only);
+    minimal-fragmentation rides the pallas min-frag kernel on TPU and
+    the XLA scan elsewhere."""
 
     def __init__(
         self,
@@ -306,7 +307,15 @@ class TpuFifoSolver:
                     jnp.asarray(problem.count),
                     jnp.asarray(queue_valid),
                 )
-                if minfrag:
+                if minfrag and self._use_pallas():
+                    from .pallas_queue import pallas_solve_queue_min_frag
+
+                    self.last_queue_lane = "pallas-minfrag"
+                    feasible_dev, _, avail_after = pallas_solve_queue_min_frag(
+                        *queue_args
+                    )
+                    feasible = np.asarray(feasible_dev)[:n_earlier]
+                elif minfrag:
                     self.last_queue_lane = "minfrag-xla"
                     out = solve_queue_min_frag(*queue_args, with_placements=False)
                     feasible = np.asarray(out.feasible)[:n_earlier]
@@ -523,10 +532,10 @@ class TpuSingleAzFifoSolver:
         # driver choice are shared with tightly (work-conserving drain),
         # placements come from the min-frag kernel / host bisect, and the
         # zone choice sees driver-only reserved under strict parity (the
-        # reference's no-write-back quirk).  Its fused one-dispatch lane
-        # is the XLA scan with minfrag=True (the pallas kernel packs
-        # tightly only); az_aware has no min-frag variant in the
-        # reference.
+        # reference's no-write-back quirk).  Both fused one-dispatch
+        # lanes serve it (XLA scan with minfrag=True; pallas kernel with
+        # the min-frag drain per zone); az_aware has no min-frag variant
+        # in the reference.
         assert not (az_aware and inner_policy == "minimal-fragmentation")
         self.az_aware = az_aware
         self.backend = backend
@@ -658,9 +667,9 @@ class TpuSingleAzFifoSolver:
         # None = no queue pass ran (empty queue); "fused"/"host" report
         # which lane actually processed earlier drivers
         self.last_path = None
-        # min-frag inner: the fused XLA scan runs the min-frag kernel per
-        # zone (driver-only strict scores); the pallas kernel packs
-        # tightly only, so it never serves this policy.
+        # min-frag inner: both fused lanes (XLA scan and the pallas
+        # kernel) run the min-frag drain per zone with driver-only
+        # strict scores; the MF_SENT sentinel guard gates device entry.
         from .batch_solver import mf_sentinel_safe
 
         mf_fused_ok = not minfrag_inner or mf_sentinel_safe(problem.avail)
@@ -670,7 +679,7 @@ class TpuSingleAzFifoSolver:
                 s_cpu, s_gpu, inv_m, th_m, scale_c, scale_g = eff_inputs
                 queue_valid = problem.app_valid.copy()
                 queue_valid[n_earlier:] = False
-                if self._use_pallas() and not minfrag_inner:
+                if self._use_pallas():
                     from .pallas_queue import pallas_solve_queue_single_az
 
                     # disjoint zone masks → one zone index per node
@@ -699,6 +708,8 @@ class TpuSingleAzFifoSolver:
                             n_zones=len(candidate_zones),
                             az_aware=self.az_aware,
                             interpret=self.interpret,
+                            minfrag=minfrag_inner,
+                            strict=self.strict_reference_parity,
                         )
                     )
                     out = ZoneQueueSolve(

@@ -31,7 +31,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .batch_solver import EFF_SHIFT
+from .batch_solver import EFF_SHIFT, MF_SENT
 
 LANES = 128
 BIG = 2**31 - 1  # plain int: a module-level jnp scalar would be a captured const in the kernel
@@ -194,6 +194,158 @@ def _gang_core(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
     return feasible, flat_idx, is_driver, cap
 
 
+def _mf_caps(cpu, mem, gpu, ex, exec_ok):
+    """UNCLAMPED per-node capacity planes for the min-frag drain
+    (batch_solver.min_frag_capacity): MF_SENT marks unbounded nodes."""
+
+    def dim(avail_d, req):
+        unbounded = jnp.where(avail_d >= 0, MF_SENT, 0)
+        return jnp.where(req == 0, unbounded, lax.div(avail_d, jnp.maximum(req, 1)))
+
+    cap = jnp.minimum(jnp.minimum(dim(cpu, ex[0]), dim(mem, ex[1])), dim(gpu, ex[2]))
+    cap = jnp.clip(cap, 0, MF_SENT)
+    return jnp.where(exec_ok, cap, 0)
+
+
+def _mf_run(d, sub, k, node_ids):
+    """One _internal_minimal_fragmentation pass over eligibility mask
+    `sub` (batch_solver.min_frag_counts.run on [R,128] planes): the
+    drain-stop value class via 31 masked-sum probes, then the drained
+    mask and the final partial placement.  Returns (ok, drained,
+    partial_flat_idx, kstar)."""
+    dd = jnp.where(sub, d, 0)
+    dc = jnp.minimum(dd, k)
+    ok = (jnp.sum(dc) >= k) & (k > 0)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo + 1) // 2
+        good = jnp.sum(jnp.where(dd >= mid, dc, 0)) >= k
+        return (jnp.where(good, mid, lo), jnp.where(good, hi, mid - 1))
+
+    vstar, _ = lax.fori_loop(0, 31, body, (jnp.int32(1), jnp.int32(MF_SENT)))
+    s = jnp.sum(jnp.where(dd > vstar, dd, 0))  # drained classes, < k
+    r = k - s
+    tstar = jnp.maximum(r - 1, 0) // vstar
+    kstar = r - tstar * vstar
+    at = sub & (dd == vstar)
+    at_rank = _flat_cumsum_exclusive(at.astype(jnp.int32))
+    drained = (sub & (dd > vstar)) | (at & (at_rank < tstar))
+    cand = sub & (~drained) & (dd >= kstar)
+    vp = jnp.min(jnp.where(cand, dd, BIG))
+    partial = jnp.min(jnp.where(cand & (dd == vp), node_ids, BIG))
+    # empty candidate set → index 0, replicating the host argmax default
+    partial = jnp.where(partial == BIG, 0, partial)
+    return ok, drained, partial, kstar
+
+
+def _solve_min_frag(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
+    """_gang_core feasibility/driver choice + the min-frag drain
+    placement (batch_solver.min_frag_step_counts).  Returns (feasible,
+    flat_idx, is_driver, counts) where counts carry the full drain
+    values (n_i executors on node i; usage subtraction only needs
+    counts > 0, zone scores need the values)."""
+    feasible, flat_idx, is_driver, _cap = _gang_core(
+        cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids
+    )
+    ce = cpu - jnp.where(is_driver, dr[0], 0)
+    me = mem - jnp.where(is_driver, dr[1], 0)
+    ge = gpu - jnp.where(is_driver, dr[2], 0)
+    d = _mf_caps(ce, me, ge, ex, exec_ok)
+    elig = d > 0
+
+    max_cap = jnp.max(d)
+    has_sent = jnp.any(elig & (d == MF_SENT))
+    # exact (k + max)//2 without int32 overflow (batch_solver quirk:
+    # an unbounded node's host threshold admits every bounded capacity)
+    target = (k // 2) + (max_cap // 2) + (((k & 1) + (max_cap & 1)) // 2)
+    subset = elig & jnp.where(has_sent, d < MF_SENT, d < target)
+    attempt = has_sent | (k < max_cap)
+
+    sub_ok, sub_drained, sub_partial, sub_kstar = _mf_run(
+        d, subset & attempt, k, node_ids
+    )
+    full_ok, full_drained, full_partial, full_kstar = _mf_run(
+        d, elig, k, node_ids
+    )
+    use_sub = attempt & sub_ok
+    drained = jnp.where(use_sub, sub_drained, full_drained)
+    partial = jnp.where(use_sub, sub_partial, full_partial)
+    kstar = jnp.where(use_sub, sub_kstar, full_kstar)
+    counts = jnp.where(drained, d, 0) + jnp.where(
+        node_ids == partial, kstar, 0
+    )
+    counts = jnp.where(full_ok & feasible, counts, 0)
+    return feasible, flat_idx, is_driver, counts
+
+
+def _minfrag_queue_kernel(
+    # scalar prefetch (SMEM)
+    dcpu, dmem, dgpu, ecpu, emem, egpu, ks, valids,
+    # VMEM planes
+    avail0, availm0, availg0, rank_ref, execok_ref,
+    # outputs
+    feas_ref, avail_out, availm_out, availg_out,
+    # scratch
+    ac, am, ag,
+    *,
+    n_apps: int,
+):
+    """Whole minimal-fragmentation FIFO queue in one VMEM-resident
+    kernel (batch_solver.solve_queue_min_frag decision semantics:
+    tightly-pack feasibility/driver identity, min-frag drain placement,
+    the usage-subtraction quirk on the carry)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        ac[...] = avail0[...]
+        am[...] = availm0[...]
+        ag[...] = availg0[...]
+
+    rank = rank_ref[...]
+    exec_ok = execok_ref[...] != 0
+    rows, lanes = rank.shape
+    row_ids = lax.broadcasted_iota(jnp.int32, (rows, lanes), 0)
+    lane_ids = lax.broadcasted_iota(jnp.int32, (rows, lanes), 1)
+    node_ids = row_ids * lanes + lane_ids
+    out_lanes = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
+    dr = jnp.array([dcpu[i], dmem[i], dgpu[i]], dtype=jnp.int32)
+    ex = jnp.array([ecpu[i], emem[i], egpu[i]], dtype=jnp.int32)
+    k = ks[i]
+    valid = valids[i]
+
+    cpu, mem, gpu = ac[...], am[...], ag[...]
+    feasible0, flat_idx, is_driver0, counts = _solve_min_frag(
+        cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids
+    )
+    feasible = feasible0 & (valid != 0)
+    is_driver = is_driver0 & feasible
+    exec_mask = (counts > 0) & feasible
+
+    dc = jnp.where(exec_mask, ex[0], jnp.where(is_driver & ~exec_mask, dr[0], 0))
+    dm = jnp.where(exec_mask, ex[1], jnp.where(is_driver & ~exec_mask, dr[1], 0))
+    dg = jnp.where(exec_mask, ex[2], jnp.where(is_driver & ~exec_mask, dr[2], 0))
+    ac[...] = cpu - dc
+    am[...] = mem - dm
+    ag[...] = gpu - dg
+
+    idx_val = jnp.where(feasible, flat_idx, jnp.int32(rows * lanes))
+    out_row = jnp.where(
+        out_lanes == 0,
+        feasible.astype(jnp.int32),
+        jnp.where(out_lanes == 1, idx_val, 0),
+    )
+    feas_ref[pl.ds(i % 8, 1), :] = out_row
+
+    @pl.when(i == n_apps - 1)
+    def _final():
+        avail_out[...] = ac[...]
+        availm_out[...] = am[...]
+        availg_out[...] = ag[...]
+
+
 def _solve_tightly(cpu, mem, gpu, rank, exec_ok, dr, ex, k, node_ids):
     """_gang_core + the tightly-pack greedy fill.  Returns (feasible,
     flat_idx, is_driver, exec_counts)."""
@@ -220,12 +372,16 @@ def _singleaz_kernel(
     n_zones: int,
     az_aware: bool,
     n_apps: int,
+    minfrag: bool = False,
+    strict: bool = True,
 ):
     """Whole single-AZ FIFO queue in one VMEM-resident kernel: the
     pallas counterpart of batch_solver.solve_queue_single_az (same
-    decision semantics: per-zone tightly-pack, certified fixed-point
-    zone score at EFF_SHIFT=18, strict-improvement choice in zone
-    order, az-aware cross-zone fallback, subtraction quirk)."""
+    decision semantics: per-zone tightly-pack — or the min-frag drain
+    when minfrag=True, with driver-only efficiency reservations under
+    strict parity — certified fixed-point zone score at EFF_SHIFT=18,
+    strict-improvement choice in zone order, az-aware cross-zone
+    fallback, subtraction quirk)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -269,11 +425,15 @@ def _singleaz_kernel(
     chosen_driver = jnp.zeros((rows, lanes), jnp.int32)
     chosen_idx = jnp.int32(rows * lanes)
 
-    def score(x, is_driver):
+    def score(x, is_driver, res=None):
+        # x weights the occurrences; `res` (default x) is the
+        # reservation seen by the efficiency numerators — they differ
+        # only under min-frag strict parity (the no-write-back quirk)
+        res = x if res is None else res
         w = x + is_driver.astype(jnp.int32)
-        new_c = x * ex[0] + jnp.where(is_driver, dr[0], 0)
-        new_m = x * ex[1] + jnp.where(is_driver, dr[1], 0)
-        new_g = x * ex[2] + jnp.where(is_driver, dr[2], 0)
+        new_c = res * ex[0] + jnp.where(is_driver, dr[0], 0)
+        new_m = res * ex[1] + jnp.where(is_driver, dr[1], 0)
+        new_g = res * ex[2] + jnp.where(is_driver, dr[2], 0)
         m_c = cpu - new_c
         m_m = mem - new_m
         m_g = gpu - new_g
@@ -296,11 +456,19 @@ def _singleaz_kernel(
 
     for z in range(n_zones):
         mask = zone_plane == z
-        f, flat_idx, is_driver, x = _solve_tightly(
-            cpu, mem, gpu,
-            jnp.where(mask, rank, BIG), exec_ok & mask, dr, ex, k, node_ids,
-        )
-        q_sum, nz = score(x, is_driver)
+        if minfrag:
+            f, flat_idx, is_driver, x = _solve_min_frag(
+                cpu, mem, gpu,
+                jnp.where(mask, rank, BIG), exec_ok & mask, dr, ex, k, node_ids,
+            )
+            res = jnp.zeros_like(x) if strict else x
+            q_sum, nz = score(x, is_driver, res=res)
+        else:
+            f, flat_idx, is_driver, x = _solve_tightly(
+                cpu, mem, gpu,
+                jnp.where(mask, rank, BIG), exec_ok & mask, dr, ex, k, node_ids,
+            )
+            q_sum, nz = score(x, is_driver)
         first = best_zone < 0
         better = f & jnp.where(first, nz, q_sum > best_q)
         uncertain = uncertain | (
@@ -353,7 +521,7 @@ def _singleaz_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_zones", "az_aware", "interpret")
+    jax.jit, static_argnames=("n_zones", "az_aware", "interpret", "minfrag", "strict")
 )
 def pallas_solve_queue_single_az(
     avail: jnp.ndarray,        # [N, 3] int32
@@ -373,11 +541,17 @@ def pallas_solve_queue_single_az(
     n_zones: int = 1,
     az_aware: bool = False,
     interpret: bool = False,
+    minfrag: bool = False,
+    strict: bool = True,
 ):
     """Single-kernel single-AZ FIFO solve.  Returns (feasible[A],
     zone_idx[A], driver_idx[A], uncertain[A], avail_after[N, 3]) with
     decisions identical to batch_solver.solve_queue_single_az
-    (tests/test_pallas_queue.py proves it on randomized queues)."""
+    (tests/test_pallas_queue.py proves it on randomized queues).
+    minfrag=True gives the single-az-minimal-fragmentation inner policy
+    (no az_aware variant exists in the reference; caller guards
+    mf_sentinel_safe)."""
+    assert not (az_aware and minfrag)
     n = avail.shape[0]
     a = drivers.shape[0]
     rows, padded = _row_layout(n)
@@ -388,7 +562,8 @@ def pallas_solve_queue_single_az(
         return flat.reshape(rows, LANES)
 
     kernel = functools.partial(
-        _singleaz_kernel, n_zones=n_zones, az_aware=az_aware, n_apps=a
+        _singleaz_kernel, n_zones=n_zones, az_aware=az_aware, n_apps=a,
+        minfrag=minfrag, strict=strict,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=10,
@@ -434,6 +609,71 @@ def pallas_solve_queue_single_az(
         [c_out.reshape(-1)[:n], m_out.reshape(-1)[:n], g_out.reshape(-1)[:n]], axis=1
     )
     return feasible, zone_idx, driver_idx, uncertain, avail_after
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_solve_queue_min_frag(
+    avail: jnp.ndarray,        # [N, 3] int32
+    driver_rank: jnp.ndarray,  # [N] int32
+    exec_ok: jnp.ndarray,      # [N] bool
+    drivers: jnp.ndarray,      # [A, 3] int32
+    executors: jnp.ndarray,    # [A, 3] int32
+    counts: jnp.ndarray,       # [A] int32
+    app_valid: jnp.ndarray,    # [A] bool
+    interpret: bool = False,
+):
+    """Whole minimal-fragmentation FIFO queue in ONE pallas kernel.
+    Returns (feasible[A] bool, driver_idx[A] int32, avail_after[N,3])
+    with decisions identical to batch_solver.solve_queue_min_frag
+    (tests/test_pallas_queue.py::test_pallas_min_frag_matches_xla).
+    Caller guards batch_solver.mf_sentinel_safe, like the XLA lane."""
+    n = avail.shape[0]
+    a = drivers.shape[0]
+    rows, padded = _row_layout(n)
+
+    def plane(v, fill=0):
+        flat = jnp.full((padded,), fill, dtype=jnp.int32)
+        flat = flat.at[:n].set(v.astype(jnp.int32))
+        return flat.reshape(rows, LANES)
+
+    kernel = functools.partial(_minfrag_queue_kernel, n_apps=a)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(a,),
+        in_specs=[pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0))] * 5,
+        out_specs=[
+            pl.BlockSpec((8, LANES), lambda i, *refs: (i // 8, 0)),
+            pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
+            pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
+            pl.BlockSpec((rows, LANES), lambda i, *refs: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((rows, LANES), jnp.int32)] * 3,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((a, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+    ]
+    feas, c_out, m_out, g_out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        drivers[:, 0], drivers[:, 1], drivers[:, 2],
+        executors[:, 0], executors[:, 1], executors[:, 2],
+        counts, app_valid.astype(jnp.int32),
+        plane(avail[:, 0]), plane(avail[:, 1]), plane(avail[:, 2]),
+        plane(driver_rank, fill=int(BIG)),
+        plane(exec_ok.astype(jnp.int32)),
+    )
+    feasible = feas[:, 0] != 0
+    driver_idx = jnp.where(feasible, feas[:, 1], jnp.int32(n))
+    avail_after = jnp.stack(
+        [c_out.reshape(-1)[:n], m_out.reshape(-1)[:n], g_out.reshape(-1)[:n]], axis=1
+    )
+    return feasible, driver_idx, avail_after
 
 
 @functools.partial(

@@ -325,12 +325,19 @@ def test_single_az_fused_near_tie_falls_back_to_host():
     assert outcome.result.executor_nodes == expected.executor_nodes
 
 
-@pytest.mark.parametrize("az_aware", [False, True])
-def test_single_az_pallas_solver_wiring(az_aware):
+@pytest.mark.parametrize(
+    "az_aware,inner_policy",
+    [
+        (False, "tightly-pack"),
+        (True, "tightly-pack"),
+        (False, "minimal-fragmentation"),
+    ],
+)
+def test_single_az_pallas_solver_wiring(az_aware, inner_policy):
     """The solver's pallas branch (zone_vec build, [1]-shaped scale
-    arrays, FusedQueueOut adaptation) must produce the same outcomes as
-    the XLA branch — run in interpreter mode so the wiring is covered on
-    CPU, not just on TPU hardware."""
+    arrays, FusedQueueOut adaptation, min-frag inner routing) must
+    produce the same outcomes as the XLA branch — run in interpreter
+    mode so the wiring is covered on CPU, not just on TPU hardware."""
     from k8s_spark_scheduler_tpu.ops.fifo_solver import TpuSingleAzFifoSolver
 
     rng = random.Random(5151 + az_aware)
@@ -343,11 +350,16 @@ def test_single_az_pallas_solver_wiring(az_aware):
         current = random_app(rng)
         args = (metadata, driver_order, executor_order, earlier, skip_allowed, current)
 
-        xla = TpuSingleAzFifoSolver(az_aware=az_aware, backend="xla")
+        xla = TpuSingleAzFifoSolver(
+            az_aware=az_aware, backend="xla", inner_policy=inner_policy
+        )
         ref = xla.solve(*args)
         if xla.last_path != "fused":
             continue
-        pal = TpuSingleAzFifoSolver(az_aware=az_aware, backend="pallas", interpret=True)
+        pal = TpuSingleAzFifoSolver(
+            az_aware=az_aware, backend="pallas", interpret=True,
+            inner_policy=inner_policy,
+        )
         got = pal.solve(*args)
         assert pal.last_path == "fused", f"trial {trial}"
         compared += 1

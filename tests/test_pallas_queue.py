@@ -136,6 +136,105 @@ def test_pallas_single_az_matches_xla(az_aware):
     assert compared >= 5, f"only {compared}/8 trials were comparable"
 
 
+def test_pallas_min_frag_matches_xla():
+    """The VMEM min-frag queue kernel (value-class binary search in
+    scratch) must match solve_queue_min_frag decision-for-decision."""
+    from k8s_spark_scheduler_tpu.ops.batch_solver import (
+        mf_sentinel_safe,
+        solve_queue_min_frag,
+    )
+    from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue_min_frag
+
+    rng = random.Random(424242)
+    for trial in range(8):
+        problem = _problem(rng, rng.randint(2, 40), rng.randint(1, 20))
+        assert mf_sentinel_safe(problem.avail)
+        args = (
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(problem.driver),
+            jnp.asarray(problem.executor),
+            jnp.asarray(problem.count),
+            jnp.asarray(problem.app_valid),
+        )
+        ref = solve_queue_min_frag(*args, with_placements=False)
+        feas, didx, avail_after = pallas_solve_queue_min_frag(*args, interpret=True)
+        tag = f"trial {trial}"
+        assert (np.asarray(feas) == np.asarray(ref.feasible)).all(), tag
+        assert (np.asarray(didx) == np.asarray(ref.driver_idx)).all(), tag
+        assert (np.asarray(avail_after) == np.asarray(ref.avail_after)).all(), tag
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_pallas_single_az_min_frag_matches_xla(strict):
+    """Single-AZ queue kernel with the min-frag inner policy: per-zone
+    drain placements, driver-only strict scores, uncertainty flags and
+    the carried availability all equal to the XLA scan."""
+    from k8s_spark_scheduler_tpu.ops.batch_adapter import candidate_zone_masks
+    from k8s_spark_scheduler_tpu.ops.batch_solver import solve_queue_single_az
+    from k8s_spark_scheduler_tpu.ops.fifo_solver import _fused_efficiency_inputs
+    from k8s_spark_scheduler_tpu.ops.pallas_queue import pallas_solve_queue_single_az
+
+    rng = random.Random(555 + strict)
+    compared = 0
+    for trial in range(10):
+        metadata = random_cluster(rng, rng.randint(2, 30))
+        apps = [random_app(rng) for _ in range(rng.randint(1, 12))]
+        driver_order, executor_order = orders_for(metadata, rng)
+        cluster = tensorize_cluster(metadata, driver_order, executor_order)
+        problem = scale_problem(cluster, tensorize_apps(apps))
+        if not problem.ok:
+            continue
+        eff = _fused_efficiency_inputs(cluster, problem)
+        if eff is None:
+            continue
+        s_cpu, s_gpu, inv_m, th_m, scale_c, scale_g = eff
+        nb = problem.avail.shape[0]
+        candidate_zones, zone_masks = candidate_zone_masks(
+            driver_order, executor_order, metadata, cluster.node_names, nb
+        )
+        common = (
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+        )
+        app_args = (
+            jnp.asarray(problem.driver),
+            jnp.asarray(problem.executor),
+            jnp.asarray(problem.count),
+            jnp.asarray(problem.app_valid),
+            jnp.asarray(s_cpu),
+            jnp.asarray(s_gpu),
+            jnp.asarray(inv_m),
+            jnp.asarray(th_m),
+        )
+        ref = solve_queue_single_az(
+            *common, jnp.asarray(zone_masks), *app_args,
+            jnp.int32(scale_c), jnp.int32(scale_g),
+            az_aware=False, minfrag=True, strict=strict,
+        )
+        zone_vec = np.full(nb, -1, np.int32)
+        for zi in range(len(candidate_zones)):
+            zone_vec[zone_masks[zi]] = zi
+        feas, zidx, didx, unc, avail_after = pallas_solve_queue_single_az(
+            *common, jnp.asarray(zone_vec), *app_args,
+            jnp.asarray(np.array([scale_c], np.int32)),
+            jnp.asarray(np.array([scale_g], np.int32)),
+            n_zones=len(candidate_zones), az_aware=False, interpret=True,
+            minfrag=True, strict=strict,
+        )
+        compared += 1
+        tag = f"trial {trial}"
+        assert (np.asarray(feas) == np.asarray(ref.feasible)).all(), tag
+        if candidate_zones:
+            assert (np.asarray(zidx) == np.asarray(ref.zone_idx)).all(), tag
+        assert (np.asarray(didx) == np.asarray(ref.driver_idx)).all(), tag
+        assert (np.asarray(unc) == np.asarray(ref.uncertain)).all(), tag
+        assert (np.asarray(avail_after) == np.asarray(ref.avail_after)).all(), tag
+    assert compared >= 5, f"only {compared}/10 trials were comparable"
+
+
 def test_pallas_empty_and_infeasible():
     # all-infeasible queue must leave availability untouched
     metadata = {
