@@ -58,20 +58,82 @@ CHAIN = int(os.environ.get("BENCH_CHAIN", "20"))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "15"))
 
 _RESULT_PREFIX = "BENCH_RESULT_JSON "
+_LANES_PREFIX = "BENCH_LANES_JSON "
 # worker exit code for "backend came up but is not a TPU" (no point
 # retrying in that case — the platform config, not the relay, is wrong)
 _EXIT_NOT_TPU = 3
+
+# every measured lane lands here ({name: {p99_ms, p50_ms, ...}}) and is
+# written to BENCH_RESULT.json at the end — the durable all-lane record
+# (VERDICT r3 #1: the stdout tail is not the only copy of the evidence)
+LANES: dict = {}
+SECONDARY: dict = {}
+
+
+def _machine_fingerprint() -> str:
+    """Short hash of the executing host's CPU identity.  Keys the
+    persistent XLA cache directory: a CPU AOT entry compiled on another
+    machine's feature set can SIGILL (r3: cpu_aot_loader spew nulled the
+    round artifact), so cache entries must never cross hosts."""
+    import hashlib
+    import platform
+
+    bits = [platform.machine(), platform.system()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags")):
+                    bits.append(line.strip())
+                    if len(bits) >= 4:
+                        break
+    except OSError:
+        pass
+    try:
+        import jaxlib
+
+        bits.append(getattr(jaxlib, "__version__", ""))
+    except Exception:
+        pass
+    return hashlib.sha1("|".join(bits).encode()).hexdigest()[:12]
+
+
+def _host_info() -> dict:
+    """Host context recorded with every artifact so cross-round numbers
+    are comparable (the r1→r3 spread was load noise with no record)."""
+    import platform
+
+    info = {
+        "fingerprint": _machine_fingerprint(),
+        "platform": platform.platform(),
+        "nproc": os.cpu_count(),
+    }
+    try:
+        info["loadavg_1m"], info["loadavg_5m"], info["loadavg_15m"] = [
+            round(v, 2) for v in os.getloadavg()
+        ]
+    except OSError:
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    info["cpu_model"] = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return info
 
 
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache shared across bench processes:
     fresh-subprocess TPU attempts (and re-runs after a relay wedge) hit
-    the cache instead of paying the 3-20s compile every time."""
+    the cache instead of paying the 3-20s compile every time.  The dir
+    is keyed by machine fingerprint — entries never load cross-host."""
     try:
         import jax
 
-        cache_dir = os.environ.get(
-            "BENCH_JAX_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
+        cache_dir = os.environ.get("BENCH_JAX_CACHE") or os.path.join(
+            os.path.dirname(__file__), ".jax_cache", _machine_fingerprint()
         )
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
@@ -174,6 +236,7 @@ def _measure_chained(one_solve, args, label: str):
         elapsed = time.perf_counter() - t0
         lat_ms.append(max(elapsed - rtt_s, 0.0) / CHAIN * 1000.0)
     lat = np.array(lat_ms)
+    LANES[label] = _lane_stats(lat, feasible_count, rtt_s=rtt_s, compile_s=compile_s)
     print(
         f"# [{label}] p99={np.percentile(lat, 99):.2f}ms "
         f"p50={np.percentile(lat, 50):.2f}ms mean={lat.mean():.2f}ms "
@@ -184,10 +247,26 @@ def _measure_chained(one_solve, args, label: str):
     return lat, feasible_count, rtt_s
 
 
+def _lane_stats(lat, feasible_count, rtt_s=None, compile_s=None) -> dict:
+    stats = {
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "mean_ms": round(float(lat.mean()), 3),
+        "max_ms": round(float(lat.max()), 3),
+        "rounds": int(lat.size),
+        "feasible": int(feasible_count),
+    }
+    if rtt_s is not None:
+        stats["rtt_ms"] = round(rtt_s * 1000.0, 3)
+    if compile_s is not None:
+        stats["compile_s"] = round(compile_s, 2)
+    return stats
+
+
 def _emit(
     lat, feasible_count, rtt_s, marshal_s, backend: str, extra: str = "",
     as_worker: bool = False,
-):
+) -> dict:
     import jax
 
     p99 = float(np.percentile(lat, 99))
@@ -198,11 +277,15 @@ def _emit(
         # the floor only guards the division (tiny smoke shapes can
         # measure 0.0 after RTT subtraction); the reported value is raw
         "vs_baseline": round(TARGET_MS / max(p99, 1e-3), 3),
+        # which lane produced the headline — consumers (the sentinel)
+        # key off this, never off stderr diagnostics
+        "backend": backend,
     }
-    line = json.dumps(result)
-    # the worker's stdout is parsed by the parent (prefixed line); the
-    # parent's stdout is parsed by the driver (exactly one bare JSON line)
-    print(_RESULT_PREFIX + line if as_worker else line)
+    if as_worker:
+        # the worker's stdout is parsed by the parent (prefixed lines);
+        # the parent re-emits the one bare JSON line the driver parses
+        print(_RESULT_PREFIX + json.dumps(result))
+        print(_LANES_PREFIX + json.dumps(LANES))
     print(
         f"# p50={np.percentile(lat, 50):.2f}ms mean={lat.mean():.2f}ms "
         f"max={lat.max():.2f}ms relay_rtt={rtt_s * 1000:.1f}ms "
@@ -211,6 +294,7 @@ def _emit(
         f"backend={backend} chain={CHAIN}{extra}",
         file=sys.stderr,
     )
+    return result
 
 
 def tpu_worker() -> int:
@@ -273,6 +357,10 @@ def tpu_worker() -> int:
             best = (p99, aps, lat, feasible_count, rtt_s)
 
     _, aps, lat, feasible_count, rtt_s = best
+    # result lines print BEFORE the diagnostics: the diags run fresh TPU
+    # programs through the wedge-prone relay, and a wedge there must not
+    # cost the completed measurement (the parent parses partial output
+    # of a killed worker)
     _emit(
         lat,
         feasible_count,
@@ -282,6 +370,7 @@ def tpu_worker() -> int:
         extra=f" apps_per_step={aps}",
         as_worker=True,
     )
+    sys.stdout.flush()
     _single_az_diag(problem, rtt_s)
     _min_frag_diag(problem, rtt_s)
     return 0
@@ -494,26 +583,38 @@ def _run_tpu_worker_attempt(timeout_s: float) -> dict | None | str:
             outf,
             sys.stderr,  # stream worker diagnostics through
         )
-        if code is None:
-            print(
-                f"# TPU worker hung past {timeout_s:.0f}s (relay wedged?); killed",
-                file=sys.stderr,
-            )
-            return None
         if code == _EXIT_NOT_TPU:
             return "not-tpu"
-        if code != 0:
-            print(f"# TPU worker exited rc={code}", file=sys.stderr)
-            return None
+        if code is None:
+            print(
+                f"# TPU worker hung past {timeout_s:.0f}s (relay wedged?); "
+                "killed (parsing partial output)",
+                file=sys.stderr,
+            )
+        elif code != 0:
+            print(
+                f"# TPU worker exited rc={code} (parsing partial output)",
+                file=sys.stderr,
+            )
+        # parse whatever reached stdout even on a hang/crash: the result
+        # prints before the diagnostics, so a measurement that completed
+        # and then wedged in a diag is still evidence
         outf.seek(0)
+        result = None
         for raw in outf.read().decode(errors="replace").splitlines():
             if raw.startswith(_RESULT_PREFIX):
                 try:
-                    return json.loads(raw[len(_RESULT_PREFIX):])
+                    result = json.loads(raw[len(_RESULT_PREFIX):])
                 except json.JSONDecodeError:
-                    return None
-        print("# TPU worker exited 0 but printed no result", file=sys.stderr)
-        return None
+                    continue
+            elif raw.startswith(_LANES_PREFIX):
+                try:
+                    LANES.update(json.loads(raw[len(_LANES_PREFIX):]))
+                except json.JSONDecodeError:
+                    pass
+        if result is None and code == 0:
+            print("# TPU worker exited 0 but printed no result", file=sys.stderr)
+        return result
 
 
 def try_tpu(budget_s: float, attempt_s: float) -> dict | None:
@@ -560,7 +661,7 @@ def try_tpu(budget_s: float, attempt_s: float) -> dict | None:
     return None
 
 
-def cpu_fallback() -> None:
+def cpu_fallback() -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -601,9 +702,8 @@ def cpu_fallback() -> None:
     lat, feasible_count, rtt_s = _measure_chained(one_solve, args, label="xla-scan cpu")
     if native is not None:
         nat_lat, nat_feasible = native
-        _emit(nat_lat, nat_feasible, 0.0, marshal_s, backend="native-cpp")
-    else:
-        _emit(lat, feasible_count, rtt_s, marshal_s, backend="xla-scan")
+        return _emit(nat_lat, nat_feasible, 0.0, marshal_s, backend="native-cpp")
+    return _emit(lat, feasible_count, rtt_s, marshal_s, backend="xla-scan")
 
 
 def _native_cpu_measure(problem):
@@ -641,6 +741,7 @@ def _native_cpu_measure(problem):
             one()
             lat_ms.append((time.perf_counter() - t0) * 1000.0)
         lat = np.array(lat_ms)
+        LANES["native-cpp cpu"] = _lane_stats(lat, feasible_count)
         print(
             f"# [native-cpp cpu] p99={np.percentile(lat, 99):.2f}ms "
             f"p50={np.percentile(lat, 50):.2f}ms mean={lat.mean():.2f}ms "
@@ -657,16 +758,79 @@ def main() -> None:
     budget_s = float(os.environ.get("BENCH_TPU_BUDGET_S", "600"))
     attempt_s = float(os.environ.get("BENCH_TPU_ATTEMPT_S", "240"))
 
-    result = try_tpu(budget_s, attempt_s) if budget_s > 0 else None
-    if result is not None:
-        # headline came from the TPU worker (already streamed its
-        # diagnostics); re-print the one canonical JSON line here so the
-        # driver's stdout parse sees exactly one result regardless of path
-        print(json.dumps(result))
-    else:
+    headline = try_tpu(budget_s, attempt_s) if budget_s > 0 else None
+    if headline is None:
         print("# TPU backend unavailable; benching on CPU", file=sys.stderr)
-        cpu_fallback()
+        headline = cpu_fallback()
+    # write the durable artifact BEFORE the secondary configs: a kill
+    # during those (they are unbounded harness runs) must not cost the
+    # headline evidence; rewritten afterwards with SECONDARY filled in
+    _write_bench_result(headline, commit=False)
     _secondary_configs()
+    _write_bench_result(headline)
+    # the headline is the FINAL stdout line, emitted after everything
+    # that could possibly crash or spew — a tail-window capture (the
+    # driver's) can never lose it to later output (VERDICT r3 #1)
+    print(json.dumps(headline))
+
+
+def _write_bench_result(headline: dict, commit: bool = True) -> None:
+    """Durable all-lane artifact: BENCH_RESULT.json on disk, committed
+    best-effort — the round's evidence survives even when the driver's
+    stdout capture doesn't.  Non-canonical (smoke) shapes write to a
+    side path so they can never clobber canonical evidence."""
+    canonical = (N_NODES, N_APPS) == (10000, 1000)
+    artifact = {
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "headline": headline,
+        "lanes": LANES,
+        "secondary_configs": SECONDARY,
+        "host": _host_info(),
+        "shape": {"nodes": N_NODES, "apps": N_APPS, "chain": CHAIN, "rounds": ROUNDS},
+        "target_ms": TARGET_MS,
+    }
+    name = "BENCH_RESULT.json" if canonical else "BENCH_RESULT_smoke.json"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(repo, name)
+    try:
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+    except OSError as err:
+        print(f"# could not write {name}: {err}", file=sys.stderr)
+        return
+    # only canonical-shape runs are evidence worth a commit
+    if not commit or not canonical or os.environ.get("BENCH_NO_COMMIT"):
+        return
+    msg = (
+        f"bench evidence: {headline.get('backend')} p99 {headline.get('value')}ms"
+    )
+    try:
+        for attempt in range(5):
+            add = subprocess.run(
+                ["git", "-C", repo, "add", "--", name],
+                capture_output=True, text=True, timeout=30,
+            )
+            if add.returncode == 0:
+                done = subprocess.run(
+                    ["git", "-C", repo, "commit", "-m", msg, "--", name],
+                    capture_output=True, text=True, timeout=30,
+                )
+                if done.returncode == 0:
+                    print(f"# committed {name}", file=sys.stderr)
+                    return
+                err_txt = done.stderr.strip() or done.stdout.strip()
+            else:
+                err_txt = add.stderr.strip()
+            # a busy index (sentinel/driver committing concurrently)
+            # clears quickly; anything else will fail all 5 attempts
+            print(
+                f"# {name} commit attempt {attempt} failed: {err_txt[-200:]}",
+                file=sys.stderr,
+            )
+            time.sleep(2.0)
+    except Exception as err:  # evidence-commit is best-effort
+        print(f"# {name} commit failed: {err}", file=sys.stderr)
 
 
 def _secondary_configs() -> None:
@@ -699,6 +863,7 @@ def _secondary_configs() -> None:
         result = h.schedule(pods[0], nodes)
         assert result.node_names, result.failed_nodes
         cfg1_ms = (time.perf_counter() - t0) * 1000
+        SECONDARY["config1_tightly_pack_e2e_ms"] = round(cfg1_ms, 1)
         print(f"# config1 tightly-pack 1+8@32nodes: {cfg1_ms:.1f}ms e2e", file=sys.stderr)
 
         # (2) FIFO queue of 128 static apps drained in order
@@ -713,6 +878,8 @@ def _secondary_configs() -> None:
         t0 = time.perf_counter()
         granted = sum(1 for d in drivers if h.schedule(d, nodes).node_names)
         cfg2_ms = (time.perf_counter() - t0) * 1000
+        SECONDARY["config2_fifo128_ms_per_app"] = round(cfg2_ms / 128, 2)
+        SECONDARY["config2_fifo128_granted"] = granted
         print(
             f"# config2 FIFO 128 apps: {cfg2_ms:.0f}ms total "
             f"({cfg2_ms / 128:.1f}ms/app, {granted} granted)",
@@ -727,6 +894,7 @@ def _secondary_configs() -> None:
         for p in da[1:]:
             h.schedule(p, nodes)
         cfg4_ms = (time.perf_counter() - t0) * 1000
+        SECONDARY["config4_da_e2e_ms"] = round(cfg4_ms, 1)
         sr, _ = h.server.soft_reservation_store.get_soft_reservation("cfg4")
         print(
             f"# config4 DA min2/max8: {cfg4_ms:.0f}ms for driver+8 executors, "
@@ -784,6 +952,7 @@ def _config3(nodes_per_group: int) -> None:
         result = h.schedule(pods[0], batch_nodes)
         assert result.node_names, result.failed_nodes
         cfg3_ms = (time.perf_counter() - t0) * 1000
+        SECONDARY["config3_label_priority_e2e_ms"] = round(cfg3_ms, 1)
         print(
             f"# config3 heterogeneous 3-group label-priority: {cfg3_ms:.1f}ms e2e "
             f"(driver on {result.node_names[0]})",

@@ -46,11 +46,15 @@ def run_bench(budget_s: float, log_path: str) -> dict | None:
     headline came from the TPU worker.
 
     Wedge/overrun survival is run_detached's poll-loop kill.  Even on a
-    kill we still parse whatever reached the log: the TPU headline
-    prints before bench's unbounded secondary CPU configs, so a late
-    overrun must not discard already-captured evidence."""
+    kill we still parse whatever reached the log, and fall back to the
+    BENCH_RESULT.json bench writes to disk before its unbounded
+    secondary CPU configs — a late overrun must not discard
+    already-captured evidence."""
     from k8s_spark_scheduler_tpu.utils.tpuprobe import run_detached
 
+    started_utc = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
     os.environ["BENCH_TPU_BUDGET_S"] = str(budget_s)
     with open(log_path, "wb") as lf:
         code = run_detached(
@@ -65,10 +69,11 @@ def run_bench(budget_s: float, log_path: str) -> dict | None:
         log("bench overran its deadline; killed (parsing partial log)")
     elif code != 0:
         log(f"bench exited rc={code} (parsing partial log)")
-    # the TPU path is authoritative only when the worker's pallas
-    # diagnostics are present (CPU fallback prints backend=xla-scan)
-    if "backend=pallas" not in text:
-        log("bench output has no pallas headline; not an artifact")
+    # an explicit CPU fallback is never TPU evidence, whatever else the
+    # log contains (a worker can emit pallas diagnostics then hang, and
+    # the fallback's result line would otherwise masquerade as TPU)
+    if "# TPU backend unavailable; benching on CPU" in text:
+        log("bench fell back to CPU; not a TPU artifact")
         return None
     result = None
     for line in text.splitlines():
@@ -79,7 +84,21 @@ def run_bench(budget_s: float, log_path: str) -> dict | None:
             except json.JSONDecodeError:
                 continue
     if result is None:
+        # the headline prints last; a killed bench may still have written
+        # the durable artifact before the final line
+        try:
+            with open(os.path.join(REPO, "BENCH_RESULT.json")) as f:
+                on_disk = json.load(f)
+            if on_disk.get("timestamp_utc", "") >= started_utc:
+                result = on_disk.get("headline")
+        except (OSError, json.JSONDecodeError):
+            pass
+    if result is None:
         log("bench printed no parseable result line")
+        return None
+    # authoritativeness comes from the result itself, not diagnostics
+    if result.get("backend") != "pallas":
+        log(f"headline backend is {result.get('backend')!r}, not pallas")
         return None
     diags = [l for l in text.splitlines() if l.startswith("#")]
     return {"result": result, "diagnostics": diags}
