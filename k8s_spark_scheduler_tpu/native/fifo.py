@@ -59,6 +59,17 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, _P, _P, _P, _P, _P, ctypes.c_int32,
                 _P, _P, _P, _P,
             ]
+            lib.fifo_solve_queue_minfrag.restype = ctypes.c_int
+            lib.fifo_solve_queue_minfrag.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, _P, _P, _P, _P, _P, _P, _P,
+                _P, _P,
+            ]
+            lib.fifo_solve_queue_single_az.restype = ctypes.c_int
+            lib.fifo_solve_queue_single_az.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _P, _P, _P,
+                _P, _P, _P, _P, _P, _P, _P, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, _P, _P, _P,
+            ]
             _lib = lib
         except Exception:
             logger.warning(
@@ -107,6 +118,89 @@ def solve_queue_native(
         _c(val), int(evenly), _c(feas), _c(didx),
     )
     return feas.astype(bool), didx, avail_io
+
+
+def solve_queue_min_frag_native(
+    avail: np.ndarray,        # [N, 3] int32 (not mutated)
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    drivers: np.ndarray,      # [A, 3] int32
+    executors: np.ndarray,    # [A, 3] int32
+    counts: np.ndarray,       # [A] int32
+    app_valid: np.ndarray,    # [A] bool
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(feasible[A] bool, driver_idx[A] int32, avail_after[N,3] int32) —
+    decision-identical to batch_solver.solve_queue_min_frag(...,
+    with_placements=False) on MF-sentinel-safe inputs (the same guard the
+    device lanes hold, batch_solver.mf_sentinel_safe)."""
+    lib = _build_and_load()
+    if lib is None:
+        raise RuntimeError("native fifo solver not available")
+    avail_io = np.ascontiguousarray(avail, dtype=np.int32).copy()
+    rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    drv = np.ascontiguousarray(drivers, dtype=np.int32)
+    exe = np.ascontiguousarray(executors, dtype=np.int32)
+    cnt = np.ascontiguousarray(counts, dtype=np.int32)
+    val = np.ascontiguousarray(app_valid, dtype=np.uint8)
+    nb, na = avail_io.shape[0], drv.shape[0]
+    feas = np.zeros(na, dtype=np.uint8)
+    didx = np.zeros(na, dtype=np.int32)
+    lib.fifo_solve_queue_minfrag(
+        nb, na, _c(avail_io), _c(rank), _c(eok), _c(drv), _c(exe), _c(cnt),
+        _c(val), _c(feas), _c(didx),
+    )
+    return feas.astype(bool), didx, avail_io
+
+
+def solve_queue_single_az_native(
+    avail: np.ndarray,        # [N, 3] int32 (not mutated)
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    zone_id: np.ndarray,      # [N] int32, -1 = in no candidate zone
+    drivers: np.ndarray,      # [A, 3] int32
+    executors: np.ndarray,    # [A, 3] int32
+    counts: np.ndarray,       # [A] int32
+    app_valid: np.ndarray,    # [A] bool
+    sched_base: np.ndarray,   # [N, 3] int64 base-unit schedulable rows
+    scale: np.ndarray,        # [3] int64 tensorize scale vector
+    n_zones: int,
+    az_aware: bool = False,
+    minfrag: bool = False,
+    strict: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(feasible[A] bool, zone_idx[A] int32, driver_idx[A] int32,
+    avail_after[N,3] int32) — the single-AZ FIFO pass with the zone
+    chosen by EXACT float64 average packing efficiency: decision-
+    identical to TpuSingleAzFifoSolver's host lane (pack_one +
+    _choose_best_result), with no fixed-point uncertainty valve.
+    zone_idx: chosen zone, n_zones = cross-zone fallback, -1 = none."""
+    lib = _build_and_load()
+    if lib is None:
+        raise RuntimeError("native fifo solver not available")
+    avail_io = np.ascontiguousarray(avail, dtype=np.int32).copy()
+    rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    zid = np.ascontiguousarray(zone_id, dtype=np.int32)
+    drv = np.ascontiguousarray(drivers, dtype=np.int32)
+    exe = np.ascontiguousarray(executors, dtype=np.int32)
+    cnt = np.ascontiguousarray(counts, dtype=np.int32)
+    val = np.ascontiguousarray(app_valid, dtype=np.uint8)
+    nb, na = avail_io.shape[0], drv.shape[0]
+    sched = np.zeros((nb, 3), dtype=np.int64)
+    sb = np.asarray(sched_base, dtype=np.int64)
+    sched[: sb.shape[0]] = sb[:nb]
+    scl = np.ascontiguousarray(scale, dtype=np.int64)
+    feas = np.zeros(na, dtype=np.uint8)
+    zone = np.zeros(na, dtype=np.int32)
+    didx = np.zeros(na, dtype=np.int32)
+    lib.fifo_solve_queue_single_az(
+        nb, na, int(n_zones), _c(avail_io), _c(rank), _c(eok), _c(zid),
+        _c(drv), _c(exe), _c(cnt), _c(val), _c(sched), _c(scl),
+        int(az_aware), int(minfrag), int(strict), _c(feas), _c(zone),
+        _c(didx),
+    )
+    return feas.astype(bool), zone, didx, avail_io
 
 
 def solve_app_native(

@@ -57,16 +57,26 @@ def _native_selected(backend: str) -> bool:
     """Host lane choice: 'native' forces the C++ queue solver; 'auto'
     uses it exactly when no accelerator backs jax (CPU deployments —
     the XLA scan costs ~280ms/queue at 10k×1k on one host core vs ~35ms
-    native, decision-identical per tests/test_native_fifo.py)."""
+    native, decision-identical per tests/test_native_fifo.py).  A FORCED
+    'native' with no working toolchain raises — a silent 8× degrade to
+    the XLA scan must never hide behind an explicit backend choice
+    (mirrors how a forced 'pallas' fails loudly off-TPU)."""
     if backend not in ("native", "auto"):
         return False
-    if backend == "auto":
-        import jax
-
-        if jax.default_backend() != "cpu":
-            return False
     from ..native.fifo import native_fifo_available
 
+    if backend == "native":
+        if not native_fifo_available():
+            raise RuntimeError(
+                "backend='native' was forced but the C++ fifo solver could "
+                "not be built/loaded (see native.fifo build log); use "
+                "backend='auto' for graceful degradation"
+            )
+        return True
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return False
     return native_fifo_available()
 
 
@@ -208,9 +218,9 @@ class TpuFifoSolver:
     the benched cost (queue pass + one O(N) decode solve for the
     current driver's placements).  The native lane
     (native/fifo_solver.cpp) serves accelerator-less deployments with
-    the same decisions at ~8× the XLA-scan speed (tightly/evenly only);
-    minimal-fragmentation rides the pallas min-frag kernel on TPU and
-    the XLA scan elsewhere."""
+    the same decisions at ~8× the XLA-scan speed for every policy
+    (tightly/evenly via fifo_solve_queue, minimal-fragmentation via
+    fifo_solve_queue_minfrag)."""
 
     def __init__(
         self,
@@ -223,9 +233,10 @@ class TpuFifoSolver:
         # min-frag only: whether the reference's no-efficiency-write-back
         # quirk applies to the current driver's reported efficiencies
         self.strict_reference_parity = strict_reference_parity
-        # which lane served the last queue pass ("native" / "pallas" /
-        # "xla" / "minfrag-xla"; None = no queue pass ran) — observable
-        # for tests and the tpu.fastpath lane counters
+        # which lane served the last queue pass — one of "native",
+        # "native-minfrag", "pallas", "pallas-minfrag", "xla",
+        # "minfrag-xla"; None = no queue pass ran — observable for tests
+        # and the tpu.fastpath lane counters
         self.last_queue_lane: Optional[str] = None
 
     def _use_pallas(self) -> bool:
@@ -279,15 +290,25 @@ class TpuFifoSolver:
                 # unbounded-capacity sentinel (batch_solver.MF_SENT)
                 return FifoOutcome(supported=False)
         n_earlier = len(earlier_apps)
-        # the native C++ lane serves tightly/evenly only; its decisions
-        # are differential-tested bit-identical to the device scan
-        use_native = not minfrag and self._use_native()
+        # the native C++ lane serves every policy; decisions are
+        # differential-tested bit-identical to the device scans
+        use_native = self._use_native()
 
         if n_earlier > 0:
             # whole-queue pass over the earlier drivers only
             queue_valid = problem.app_valid.copy()
             queue_valid[n_earlier:] = False
-            if use_native:
+            if use_native and minfrag:
+                from ..native.fifo import solve_queue_min_frag_native
+
+                self.last_queue_lane = "native-minfrag"
+                feasible_all, _, avail_after = solve_queue_min_frag_native(
+                    problem.avail, problem.driver_rank, problem.exec_ok,
+                    problem.driver, problem.executor, problem.count,
+                    queue_valid,
+                )
+                feasible = feasible_all[:n_earlier]
+            elif use_native:
                 from ..native.fifo import solve_queue_native
 
                 self.last_queue_lane = "native"
@@ -507,7 +528,12 @@ class TpuSingleAzFifoSolver:
     the whole earlier-driver queue on device — per-zone tightly-pack
     solves, the zone-efficiency choice in certified fixed point
     (batch_solver.EFF_SHIFT), the az-aware cross-zone fallback, and the
-    carried usage subtraction all fused into a single XLA program.
+    carried usage subtraction all fused into a single XLA program.  On
+    accelerator-less hosts (backend "auto" on CPU, or "native") the C++
+    lane (native/fifo_solver.cpp::fifo_solve_queue_single_az) runs the
+    same per-zone solves with the zone chosen by EXACT float64
+    efficiency math — host-lane decisions with no uncertainty valve, at
+    native speed.
 
     Exactness valve: any app whose zone scores land inside the
     fixed-point margin is flagged `uncertain`, and the whole queue is
@@ -517,7 +543,7 @@ class TpuSingleAzFifoSolver:
     outside the fused lane's numeric bounds (_fused_efficiency_inputs)
     go straight to the host lane.  The current app's packing is always
     chosen with the exact host math.  `last_path` records which lane ran
-    ("fused" / "host") for tests and diagnostics."""
+    ("fused" / "native" / "host") for tests and diagnostics."""
 
     def __init__(
         self,
@@ -664,31 +690,60 @@ class TpuSingleAzFifoSolver:
 
         n_earlier = len(earlier_apps)
         fused_done = False
-        # None = no queue pass ran (empty queue); "fused"/"host" report
-        # which lane actually processed earlier drivers
+        # None = no queue pass ran (empty queue); "fused"/"native"/"host"
+        # report which lane actually processed earlier drivers
         self.last_path = None
-        # min-frag inner: both fused lanes (XLA scan and the pallas
-        # kernel) run the min-frag drain per zone with driver-only
-        # strict scores; the MF_SENT sentinel guard gates device entry.
+        # min-frag inner: all fast lanes (native, XLA scan, pallas
+        # kernel) run the min-frag drain with the int32 MF_SENT
+        # sentinel, so the sentinel-collision guard gates every one of
+        # them; pathological snapshots take the exact host lane (its
+        # decode uses a 2^62 sentinel no int32 capacity can reach).
         from .batch_solver import mf_sentinel_safe
 
         mf_fused_ok = not minfrag_inner or mf_sentinel_safe(problem.avail)
-        if n_earlier > 0 and mf_fused_ok:
+        # shared by the native and pallas lanes: disjoint zone masks →
+        # one zone index per node (-1 = in no candidate zone), and the
+        # queue-only validity mask
+        zone_vec = np.full(avail.shape[0], -1, np.int32)
+        for zi in range(len(candidate_zones)):
+            zone_vec[zone_masks[zi]] = zi
+        queue_valid = problem.app_valid.copy()
+        queue_valid[n_earlier:] = False
+
+        if (
+            n_earlier > 0
+            and mf_fused_ok
+            and not self._use_pallas()
+            and _native_selected(self.backend)
+        ):
+            # native C++ lane: per-zone solves with the zone chosen by
+            # EXACT float64 efficiency math — same decisions as the host
+            # lane with no uncertainty valve, at native speed
+            from ..native.fifo import solve_queue_single_az_native
+
+            feas_n, _zone_n, _didx_n, avail_after_n = solve_queue_single_az_native(
+                avail, problem.driver_rank, np.asarray(problem.exec_ok),
+                zone_vec, problem.driver, problem.executor, problem.count,
+                queue_valid, cluster.sched, scale,
+                n_zones=len(candidate_zones), az_aware=self.az_aware,
+                minfrag=minfrag_inner, strict=self.strict_reference_parity,
+            )
+            self.last_path = "native"
+            for i in range(n_earlier):
+                if not feas_n[i] and not earlier_skip_allowed[i]:
+                    return FifoOutcome(supported=True, earlier_ok=False)
+            avail[:] = avail_after_n
+            fused_done = True
+
+        if not fused_done and n_earlier > 0 and mf_fused_ok:
             eff_inputs = _fused_efficiency_inputs(cluster, problem)
             if eff_inputs is not None:
                 s_cpu, s_gpu, inv_m, th_m, scale_c, scale_g = eff_inputs
-                queue_valid = problem.app_valid.copy()
-                queue_valid[n_earlier:] = False
                 if self._use_pallas():
                     from .pallas_queue import pallas_solve_queue_single_az
 
-                    # disjoint zone masks → one zone index per node
-                    # (-1 = in no candidate zone)
                     from .batch_solver import ZoneQueueSolve
 
-                    zone_vec = np.full(avail.shape[0], -1, np.int32)
-                    for zi in range(len(candidate_zones)):
-                        zone_vec[zone_masks[zi]] = zi
                     feas_d, zone_d, didx_d, uncertain_d, avail_after_d = (
                         pallas_solve_queue_single_az(
                             jnp.asarray(avail),

@@ -41,6 +41,9 @@ inline int32_t wrap_sub(int32_t a, int32_t b) {
 // batch_solver.node_capacity): zero-requirement dim is unbounded unless
 // availability is negative; any value ≤ 0 clips to 0, so truncating
 // division equals the device kernel's floor division after the clip.
+// A negative requirement divides by 1 like the host's max(executor, 1)
+// (unreachable with valid tensorized Resources, but the parity contract
+// covers the whole int32 input domain).
 inline int32_t clamped_cap(const int32_t* a, const int32_t* e, int32_t k) {
   int32_t cap = k;
   for (int j = 0; j < kDims; ++j) {
@@ -51,7 +54,7 @@ inline int32_t clamped_cap(const int32_t* a, const int32_t* e, int32_t k) {
       c = 0;
     } else {
       c = static_cast<int32_t>(static_cast<double>(a[j]) /
-                               static_cast<double>(e[j]));
+                               static_cast<double>(std::max(e[j], 1)));
     }
     cap = std::min(cap, c);
   }
@@ -103,6 +106,338 @@ CapPassFn select_cap_pass(const int32_t* e) {
   return kTable[idx];
 }
 
+// ---------------------------------------------------------------------------
+// Minimal-fragmentation drain (minimal_fragmentation.go:59-137 semantics,
+// matching ops/batch_adapter.minimal_fragmentation_from_capacities and —
+// under the solver's MF sentinel guard — the device kernel
+// batch_solver.min_frag_counts).
+// ---------------------------------------------------------------------------
+
+// Unbounded-capacity sentinel (the device kernel's batch_solver.MF_SENT):
+// callers hold the mf_sentinel_safe guard (scaled availabilities ≤
+// MF_SENT − 1), so a real capacity can never collide with it and the
+// explicit has-sentinel subset rule below equals the host decode's
+// 2^62-sentinel (k + max)/2 formula.
+constexpr int32_t kMfSent = 2147483646;
+
+inline int64_t floor_div32(int32_t a, int32_t b) {  // b > 0
+  return a >= 0 ? a / b : -((-(int64_t)a + b - 1) / b);
+}
+
+// UNCLAMPED per-node capacity for the min-frag drain (capacity.go:36-75:
+// floor division per dim; zero-requirement dim unbounded unless the
+// availability is already negative; negative requirement divides by 1).
+inline int32_t mf_cap_one(int32_t a0, int32_t a1, int32_t a2,
+                          const int32_t* e) {
+  const int32_t a[kDims] = {a0, a1, a2};
+  int64_t cap = kMfSent;
+  for (int j = 0; j < kDims; ++j) {
+    int64_t c;
+    if (e[j] == 0) {
+      c = a[j] >= 0 ? kMfSent : 0;
+    } else {
+      c = floor_div32(a[j], std::max(e[j], 1));
+    }
+    cap = std::min(cap, c);
+  }
+  return static_cast<int32_t>(std::max<int64_t>(cap, 0));
+}
+
+// Branchless whole-axis min-frag capacity pass, dim-specialized like
+// cap_pass.  Writes UNCLAMPED capacities (values ≤ 0 mean ineligible —
+// truncating division may differ from floor on negatives, but only the
+// sign of a non-positive capacity matters) and returns Σ clamp(c, 0, k),
+// the tightly feasibility total, so the min-frag queue step needs ONE
+// pass over the node axis instead of two.
+// Branchless extremes of a capacity vector, folded into the pass (and
+// recomputable standalone after the driver-node fix-up): the max, the
+// smallest capacity ≥ k, and the smallest positive capacity.  These
+// three values decide the whole min-frag attempt structure (see
+// mf_assign); the standalone scan vectorizes fully (~0.3 us at 10k
+// nodes), so it runs after the driver-node fix-up rather than fused
+// into the pass (where the extra accumulators break vectorization).
+struct MfExtremes {
+  int32_t maxc = 0;
+  int32_t min_ge = kBig;   // min capacity ≥ k (kBig = none)
+  int32_t min_pos = kBig;  // min capacity > 0 (kBig = none)
+};
+
+template <bool E0, bool E1, bool E2>
+int64_t mf_cap_pass(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+                    const uint8_t* elig, int64_t nb, double de0, double de1,
+                    double de2, int32_t k, int32_t* cap) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = kMfSent;
+    if (E0) c = std::min(c, static_cast<int32_t>(a0[i] / de0));
+    if (E1) c = std::min(c, static_cast<int32_t>(a1[i] / de1));
+    if (E2) c = std::min(c, static_cast<int32_t>(a2[i] / de2));
+    if (!E0) c = a0[i] >= 0 ? c : int32_t{-1};
+    if (!E1) c = a1[i] >= 0 ? c : int32_t{-1};
+    if (!E2) c = a2[i] >= 0 ? c : int32_t{-1};
+    c = elig[i] ? c : 0;
+    cap[i] = c;
+    total += std::clamp<int32_t>(c, 0, k);
+  }
+  return total;
+}
+
+MfExtremes mf_extremes(const std::vector<int32_t>& caps, int32_t k) {
+  MfExtremes ext;
+  for (const int32_t c : caps) {
+    ext.maxc = std::max(ext.maxc, c);
+    ext.min_ge = std::min(ext.min_ge, c >= k ? c : kBig);
+    ext.min_pos = std::min(ext.min_pos, c > 0 ? c : kBig);
+  }
+  return ext;
+}
+
+using MfCapPassFn = int64_t (*)(const int32_t*, const int32_t*,
+                                const int32_t*, const uint8_t*, int64_t,
+                                double, double, double, int32_t, int32_t*);
+
+MfCapPassFn select_mf_cap_pass(const int32_t* e) {
+  static constexpr MfCapPassFn kTable[8] = {
+      mf_cap_pass<false, false, false>, mf_cap_pass<false, false, true>,
+      mf_cap_pass<false, true, false>,  mf_cap_pass<false, true, true>,
+      mf_cap_pass<true, false, false>,  mf_cap_pass<true, false, true>,
+      mf_cap_pass<true, true, false>,   mf_cap_pass<true, true, true>,
+  };
+  int idx = (e[0] != 0 ? 4 : 0) | (e[1] != 0 ? 2 : 0) | (e[2] != 0 ? 1 : 0);
+  return kTable[idx];
+}
+
+// (node, executors-placed) segments in DRAIN order — the reference's
+// placement list order, which the single-AZ zone score consumes as the
+// occurrence sequence.  Nodes are unique across segments.
+using MfSegs = std::vector<std::pair<int32_t, int64_t>>;
+
+// minimal_fragmentation.go:96-137 WITHOUT the sort: the ascending-order
+// drain only ever consults (a) the first sorted entry with cap ≥ k —
+// i.e. the smallest such capacity, earliest node among equals — and
+// (b) the max-capacity class in node order, so two O(N) scans per drain
+// round replace the O(N log N) sort (a 10k-node sort per app dominated
+// the whole queue pass).  Round count is bounded by the number of fully
+// drained classes, itself ≤ k.  `caps` is by-node (≤ 0 = ineligible)
+// and is consumed (drained entries zeroed).
+bool mf_drain(std::vector<int32_t>& caps, int64_t k, MfSegs& segs) {
+  const int64_t nb = static_cast<int64_t>(caps.size());
+  while (true) {
+    int64_t best = -1;
+    int32_t best_cap = 0, maxc = 0;
+    for (int64_t i = 0; i < nb; ++i) {
+      const int32_t c = caps[i];
+      if (c <= 0) continue;
+      if (c >= k && (best < 0 || c < best_cap)) {
+        best = i;
+        best_cap = c;
+      }
+      if (c > maxc) maxc = c;
+    }
+    if (best >= 0) {  // first node that can fit everything that's left
+      segs.emplace_back(static_cast<int32_t>(best), k);
+      return true;
+    }
+    if (maxc <= 0) return false;
+    // drain the max-capacity class in node order
+    for (int64_t i = 0; i < nb && k >= maxc; ++i) {
+      if (caps[i] == maxc) {
+        segs.emplace_back(static_cast<int32_t>(i), maxc);
+        k -= maxc;
+        caps[i] = 0;
+      }
+    }
+    if (k == 0) return true;
+  }
+}
+
+// Scratch for the bucketed drain, reused across apps (allocation-free
+// steady state).
+struct MfScratch {
+  std::vector<int32_t> bucket_count;   // per capacity value in [1, k)
+  std::vector<int32_t> bucket_offset;  // cursor into nodes (consumed prefix)
+  std::vector<int32_t> bucket_end;
+  std::vector<int32_t> nodes;          // bucket-grouped node ids, node order
+  std::vector<int32_t> copy;           // fallback for the scan drain
+};
+
+// bucket-capped drain: every capacity entering a drain is < k (a cap
+// ≥ k resolves on the instant-fit probe before any draining), so a
+// counting sort by value gives O(nb + k) rounds-free access to both
+// "smallest capacity ≥ remainder" and "max class in node order".
+// `in_subset(c)` selects the eligible entries.
+template <typename Pred>
+bool mf_drain_bucketed(const std::vector<int32_t>& caps, int64_t k,
+                       Pred in_subset, MfScratch& ws, MfSegs& segs) {
+  const int64_t nb = static_cast<int64_t>(caps.size());
+  const int64_t kb = k;  // bucket domain: values 1..k-1
+  ws.bucket_count.assign(kb, 0);
+  for (int64_t i = 0; i < nb; ++i) {
+    const int32_t c = caps[i];
+    if (c > 0 && in_subset(c)) ++ws.bucket_count[c];  // c < k guaranteed
+  }
+  ws.bucket_offset.resize(kb);
+  ws.bucket_end.resize(kb);
+  int32_t total_nodes = 0;
+  for (int64_t v = 1; v < kb; ++v) {
+    ws.bucket_offset[v] = total_nodes;
+    total_nodes += ws.bucket_count[v];
+    ws.bucket_end[v] = total_nodes;
+  }
+  if (total_nodes == 0) return false;
+  ws.nodes.resize(total_nodes);
+  {
+    std::vector<int32_t>& cursor = ws.bucket_count;  // reuse as fill cursor
+    for (int64_t v = 1; v < kb; ++v) cursor[v] = ws.bucket_offset[v];
+    for (int64_t i = 0; i < nb; ++i) {
+      const int32_t c = caps[i];
+      if (c > 0 && in_subset(c)) ws.nodes[cursor[c]++] = static_cast<int32_t>(i);
+    }
+  }
+  int64_t rem = k;
+  int64_t maxv = kb - 1;
+  while (true) {
+    while (maxv >= 1 && ws.bucket_offset[maxv] == ws.bucket_end[maxv]) --maxv;
+    if (maxv < 1) return false;
+    // instant fit: smallest unconsumed capacity ≥ rem, earliest node
+    if (rem <= maxv) {
+      int64_t v = rem;
+      while (ws.bucket_offset[v] == ws.bucket_end[v]) ++v;  // ≤ maxv by above
+      segs.emplace_back(ws.nodes[ws.bucket_offset[v]], rem);
+      return true;
+    }
+    // drain the max class in node order while rem ≥ maxv
+    while (rem >= maxv && ws.bucket_offset[maxv] != ws.bucket_end[maxv]) {
+      segs.emplace_back(ws.nodes[ws.bucket_offset[maxv]++], maxv);
+      rem -= maxv;
+    }
+    if (rem == 0) return true;
+  }
+}
+
+// minimal_fragmentation.go:71-94: the avoid-mostly-empty-nodes subset
+// attempt (capacities < (k + max)/2), then the full set.  The attempt
+// structure is decided entirely from the pass's branchless extremes:
+//  - subset first probe = smallest capacity ≥ k *within* the subset.
+//    The overall smallest capacity ≥ k (min_ge) IS that winner whenever
+//    min_ge < target (subset candidates are a subset of the ≥ k
+//    candidates, all ≥ min_ge, and the min_ge node itself qualifies);
+//    if min_ge ≥ target the subset has no ≥ k member at all.
+//  - subset non-empty ⟺ the smallest positive capacity < target.
+//  - entering a drain implies every eligible capacity < k, so the
+//    counting-bucket drain applies (O(nb + k), copy-free).
+// Only the fast-path placement needs a further scan: find the earliest
+// node holding the winning capacity value.
+bool mf_assign(const std::vector<int32_t>& caps_by_node, int64_t k,
+               const MfExtremes& ext, MfScratch& ws, MfSegs& segs) {
+  segs.clear();
+  if (k <= 0 || ext.maxc <= 0) return false;
+
+  // a sentinel present makes the subset "every bounded node" and the
+  // attempt unconditional (min_frag_counts' has_sent rule — identical
+  // to the host's (k + 2^62)/2 threshold)
+  const bool has_sent = ext.maxc == kMfSent;
+  const bool attempt_subset = has_sent || k < ext.maxc;
+  const int64_t target =
+      has_sent
+          ? static_cast<int64_t>(kMfSent)
+          : (attempt_subset ? (k + static_cast<int64_t>(ext.maxc)) / 2 : 0);
+
+  auto place_first_with = [&](int32_t value) {
+    // blocked any-match (the fixed-length inner loop vectorizes; an
+    // early-exit elementwise scan would not)
+    const int64_t nb = static_cast<int64_t>(caps_by_node.size());
+    const int32_t* caps = caps_by_node.data();
+    constexpr int64_t B = 256;
+    int64_t i = 0;
+    for (; i + B <= nb; i += B) {
+      bool any = false;
+      for (int64_t j = i; j < i + B; ++j) any |= caps[j] == value;
+      if (any) break;
+    }
+    for (; i < nb; ++i) {
+      if (caps[i] == value) {
+        segs.emplace_back(static_cast<int32_t>(i), k);
+        return;
+      }
+    }
+  };
+
+  const bool have_ge = ext.min_ge != kBig && ext.min_ge >= k;
+  if (attempt_subset) {
+    if (have_ge && ext.min_ge < target) {
+      place_first_with(ext.min_ge);
+      return true;
+    }
+    const bool sub_any = ext.min_pos != kBig && ext.min_pos < target;
+    if (sub_any) {
+      // no subset capacity is ≥ k here (min_ge ≥ target or none)
+      bool ok;
+      if (k < (int64_t{1} << 16)) {
+        ok = mf_drain_bucketed(caps_by_node, k,
+                               [&](int32_t c) { return c < target; }, ws,
+                               segs);
+      } else {
+        ws.copy = caps_by_node;
+        for (int32_t& c : ws.copy) {
+          if (c >= target) c = 0;
+        }
+        ok = mf_drain(ws.copy, k, segs);
+      }
+      if (ok) return true;
+      segs.clear();
+    }
+  }
+  if (have_ge) {
+    place_first_with(ext.min_ge);
+    return true;
+  }
+  if (k < (int64_t{1} << 16)) {
+    return mf_drain_bucketed(caps_by_node, k, [](int32_t) { return true; },
+                             ws, segs);
+  }
+  ws.copy = caps_by_node;
+  return mf_drain(ws.copy, k, segs);
+}
+
+// ---------------------------------------------------------------------------
+// Exact packing-efficiency math (efficiency.go:80-105 via
+// ops/fifo_solver.efficiencies_from_rows): float64 ops in the same IEEE
+// order as the numpy columns, so zone scores are bit-identical to the
+// solver's host lane.
+// ---------------------------------------------------------------------------
+
+inline int64_t ceil_div64(int64_t a, int64_t b) {  // b > 0
+  return a >= 0 ? (a + b - 1) / b : -((-a) / b);
+}
+
+// int64 wrap arithmetic matching numpy's (signed overflow is UB in C++,
+// defined mod 2^64 via unsigned)
+inline int64_t wrap_addsub64(int64_t s, int64_t sub, int64_t add) {
+  return static_cast<int64_t>(static_cast<uint64_t>(s) -
+                              static_cast<uint64_t>(sub) +
+                              static_cast<uint64_t>(add));
+}
+
+// max(gpu, cpu, memory) of one node's reserved/schedulable ratios.
+// s* are base-unit schedulable rows (milli-cpu, bytes, milli-gpu);
+// r* the reserved numerators (same units).
+inline double max_eff(int64_t s0, int64_t s1, int64_t s2, int64_t r0,
+                      int64_t r1, int64_t r2) {
+  const int64_t den_c = std::max<int64_t>(ceil_div64(s0, 1000), 1);
+  const double cpu =
+      static_cast<double>(ceil_div64(r0, 1000)) / static_cast<double>(den_c);
+  const double mem = static_cast<double>(r1) /
+                     static_cast<double>(std::max<int64_t>(s1, 1));
+  const int64_t s_gpu = ceil_div64(s2, 1000);
+  double gpu = 0.0;
+  if (s_gpu != 0) {
+    gpu = static_cast<double>(ceil_div64(r2, 1000)) /
+          static_cast<double>(std::max<int64_t>(s_gpu, 1));
+  }
+  return std::max(gpu, std::max(cpu, mem));
+}
+
 }  // namespace
 
 extern "C" {
@@ -151,9 +486,10 @@ int fifo_solve_queue(int64_t nb, int64_t na, int32_t* avail_io,
     out_driver_idx[ai] = static_cast<int32_t>(nb);
     if (!app_valid[ai]) continue;
 
-    // pass 1: per-node capacity + total S (branchless, dim-specialized)
-    const double de0 = e[0] ? e[0] : 1.0, de1 = e[1] ? e[1] : 1.0,
-                 de2 = e[2] ? e[2] : 1.0;
+    // pass 1: per-node capacity + total S (branchless, dim-specialized);
+    // divisors floor at 1 like the host's max(executor, 1)
+    const double de0 = e[0] > 0 ? e[0] : 1.0, de1 = e[1] > 0 ? e[1] : 1.0,
+                 de2 = e[2] > 0 ? e[2] : 1.0;
     int64_t total = select_cap_pass(e)(a0.data(), a1.data(), a2.data(),
                                        exec_ok, nb, de0, de1, de2, k,
                                        cap.data());
@@ -218,6 +554,361 @@ int fifo_solve_queue(int64_t nb, int64_t na, int32_t* avail_io,
       a0[didx] = wrap_sub(a0[didx], d[0]);
       a1[didx] = wrap_sub(a1[didx], d[1]);
       a2[didx] = wrap_sub(a2[didx], d[2]);
+    }
+  }
+  for (int64_t i = 0; i < nb; ++i) {
+    avail_io[i * kDims + 0] = a0[i];
+    avail_io[i * kDims + 1] = a1[i];
+    avail_io[i * kDims + 2] = a2[i];
+  }
+  return 1;
+}
+
+// Whole-FIFO-queue solve under the minimal-fragmentation policy
+// (batch_solver.solve_queue_min_frag semantics, with_placements=False):
+// feasibility + driver choice equal tightly-pack's (the drain is work-
+// conserving); the carried usage subtraction comes from the min-frag
+// drain counts.  Caller must hold the MF sentinel guard
+// (batch_solver.mf_sentinel_safe) exactly like the device lanes.
+int fifo_solve_queue_minfrag(int64_t nb, int64_t na, int32_t* avail_io,
+                             const int32_t* driver_rank,
+                             const uint8_t* exec_ok, const int32_t* drivers,
+                             const int32_t* executors, const int32_t* counts,
+                             const uint8_t* app_valid, uint8_t* out_feasible,
+                             int32_t* out_driver_idx) {
+  std::vector<int32_t> cand;
+  cand.reserve(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    if (driver_rank[i] < kBig) cand.push_back(static_cast<int32_t>(i));
+  }
+  std::sort(cand.begin(), cand.end(), [&](int32_t x, int32_t y) {
+    return driver_rank[x] < driver_rank[y];
+  });
+
+  std::vector<int32_t> a0(nb), a1(nb), a2(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    a0[i] = avail_io[i * kDims + 0];
+    a1[i] = avail_io[i * kDims + 1];
+    a2[i] = avail_io[i * kDims + 2];
+  }
+  std::vector<int32_t> mf_caps(nb);
+  MfScratch mf_ws;
+  MfSegs segs;
+
+  for (int64_t ai = 0; ai < na; ++ai) {
+    const int32_t* d = drivers + ai * kDims;
+    const int32_t* e = executors + ai * kDims;
+    const int32_t k = counts[ai];
+    out_feasible[ai] = 0;
+    out_driver_idx[ai] = static_cast<int32_t>(nb);
+    if (!app_valid[ai]) continue;
+
+    // ONE fused pass yields both the UNCLAMPED min-frag capacities and
+    // the tightly feasibility total Σ clamp(c, 0, k)
+    const double de0 = e[0] > 0 ? e[0] : 1.0, de1 = e[1] > 0 ? e[1] : 1.0,
+                 de2 = e[2] > 0 ? e[2] : 1.0;
+    int64_t total = select_mf_cap_pass(e)(a0.data(), a1.data(), a2.data(),
+                                          exec_ok, nb, de0, de1, de2, k,
+                                          mf_caps.data());
+    int32_t didx = -1;
+    if (total >= k) {
+      for (int32_t i : cand) {
+        int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+        if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+        int32_t am[kDims];
+        for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+        int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+        if (total - std::clamp<int32_t>(mf_caps[i], 0, k) + cwd >= k) {
+          didx = i;
+          break;
+        }
+      }
+    }
+    if (didx < 0) continue;
+    out_feasible[ai] = 1;
+    out_driver_idx[ai] = didx;
+
+    // min-frag placement with the driver subtracted on its node
+    // (batch_solver.min_frag_step_counts) — only the driver node's
+    // capacity differs from the fused pass
+    if (exec_ok[didx]) {
+      int32_t av[kDims];
+      for (int j = 0; j < kDims; ++j)
+        av[j] = wrap_sub((j == 0 ? a0 : j == 1 ? a1 : a2)[didx], d[j]);
+      mf_caps[didx] = mf_cap_one(av[0], av[1], av[2], e);
+    }
+    bool placed_any =
+        k > 0 && mf_assign(mf_caps, k, mf_extremes(mf_caps, k), mf_ws, segs);
+
+    // usage subtraction quirk: one executor's worth per hosting node,
+    // the driver row on its node unless it also hosts executors
+    bool driver_hosts_exec = false;
+    if (placed_any) {
+      for (const auto& seg : segs) {
+        const int32_t i = seg.first;
+        if (i == didx) driver_hosts_exec = true;
+        a0[i] = wrap_sub(a0[i], e[0]);
+        a1[i] = wrap_sub(a1[i], e[1]);
+        a2[i] = wrap_sub(a2[i], e[2]);
+      }
+    }
+    if (!driver_hosts_exec) {
+      a0[didx] = wrap_sub(a0[didx], d[0]);
+      a1[didx] = wrap_sub(a1[didx], d[1]);
+      a2[didx] = wrap_sub(a2[didx], d[2]);
+    }
+  }
+  for (int64_t i = 0; i < nb; ++i) {
+    avail_io[i * kDims + 0] = a0[i];
+    avail_io[i * kDims + 1] = a1[i];
+    avail_io[i * kDims + 2] = a2[i];
+  }
+  return 1;
+}
+
+// Whole-FIFO-queue solve for the single-AZ policies
+// (single_az.go:23-97 × resource.go:224-262): per app, per-zone
+// tightly-pack (or min-frag) solves with the zone chosen by EXACT
+// float64 average packing efficiency — the same IEEE operation sequence
+// as the solver's host lane (pack_one → _choose_best_result), so no
+// fixed-point uncertainty valve is needed.
+//   zone_id      [nb] int32 — disjoint candidate-zone index per node
+//                (-1 = in no candidate zone)
+//   sched_base   [nb*3] int64 — base-unit schedulable rows
+//   scale        [3] int64 — tensorize scale vector
+//   az_aware     adds the cross-zone tightly-pack fallback (zone = nz)
+//   minfrag      single-az-minimal-fragmentation inner placements
+//   strict       reference no-write-back quirk: zone scores see only the
+//                driver's reservation
+//   out_zone     [na] int32 — chosen zone; nz = cross-zone; -1 = none
+int fifo_solve_queue_single_az(
+    int64_t nb, int64_t na, int64_t nz, int32_t* avail_io,
+    const int32_t* driver_rank, const uint8_t* exec_ok,
+    const int32_t* zone_id, const int32_t* drivers, const int32_t* executors,
+    const int32_t* counts, const uint8_t* app_valid,
+    const int64_t* sched_base, const int64_t* scale, int az_aware,
+    int minfrag, int strict, uint8_t* out_feasible, int32_t* out_zone,
+    int32_t* out_driver_idx) {
+  std::vector<int32_t> cand;
+  cand.reserve(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    if (driver_rank[i] < kBig) cand.push_back(static_cast<int32_t>(i));
+  }
+  std::sort(cand.begin(), cand.end(), [&](int32_t x, int32_t y) {
+    return driver_rank[x] < driver_rank[y];
+  });
+
+  std::vector<int32_t> a0(nb), a1(nb), a2(nb), cap(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    a0[i] = avail_io[i * kDims + 0];
+    a1[i] = avail_io[i * kDims + 1];
+    a2[i] = avail_io[i * kDims + 2];
+  }
+
+  std::vector<int64_t> total_z(std::max<int64_t>(nz, 1));
+  std::vector<int32_t> didx_z(std::max<int64_t>(nz, 1));
+  std::vector<int32_t> capd_z(std::max<int64_t>(nz, 1));
+  std::vector<MfSegs> segs_z(std::max<int64_t>(nz, 1));
+  std::vector<int32_t> mf_caps(nb);
+  MfScratch mf_ws;
+  // per-zone eligibility bytes: lets the min-frag capacity pass run
+  // vectorized per zone instead of a branchy zone_id test per node
+  std::vector<std::vector<uint8_t>> zone_elig;
+  if (minfrag) {
+    zone_elig.assign(std::max<int64_t>(nz, 1), std::vector<uint8_t>(nb, 0));
+    for (int64_t i = 0; i < nb; ++i) {
+      const int32_t z = zone_id[i];
+      if (z >= 0 && z < nz && exec_ok[i]) zone_elig[z][i] = 1;
+    }
+  }
+
+  // reserved/schedulable ratio of one node under this app's packing
+  // (eff_count executors + the driver when on it), exact float64
+  auto node_max_eff = [&](int64_t i, int64_t eff_count, const int32_t* d,
+                          const int32_t* e, bool is_driver) {
+    int64_t r[kDims];
+    for (int j = 0; j < kDims; ++j) {
+      const int64_t res =
+          eff_count * e[j] + (is_driver ? static_cast<int64_t>(d[j]) : 0);
+      const int64_t avail_j =
+          static_cast<int64_t>((j == 0 ? a0 : j == 1 ? a1 : a2)[i]);
+      r[j] = wrap_addsub64(
+          sched_base[i * kDims + j],
+          static_cast<int64_t>(
+              static_cast<uint64_t>(avail_j) *
+              static_cast<uint64_t>(scale[j])),
+          static_cast<int64_t>(
+              static_cast<uint64_t>(res) * static_cast<uint64_t>(scale[j])));
+    }
+    return max_eff(sched_base[i * kDims + 0], sched_base[i * kDims + 1],
+                   sched_base[i * kDims + 2], r[0], r[1], r[2]);
+  };
+
+  for (int64_t ai = 0; ai < na; ++ai) {
+    const int32_t* d = drivers + ai * kDims;
+    const int32_t* e = executors + ai * kDims;
+    const int32_t k = counts[ai];
+    out_feasible[ai] = 0;
+    out_zone[ai] = -1;
+    out_driver_idx[ai] = static_cast<int32_t>(nb);
+    if (!app_valid[ai]) continue;
+
+    const double de0 = e[0] > 0 ? e[0] : 1.0, de1 = e[1] > 0 ? e[1] : 1.0,
+                 de2 = e[2] > 0 ? e[2] : 1.0;
+    select_cap_pass(e)(a0.data(), a1.data(), a2.data(), exec_ok, nb, de0,
+                       de1, de2, k, cap.data());
+    std::fill(total_z.begin(), total_z.end(), 0);
+    for (int64_t i = 0; i < nb; ++i) {
+      const int32_t z = zone_id[i];
+      if (z >= 0 && z < nz) total_z[z] += cap[i];
+    }
+
+    // one rank-ordered walk finds every zone's first feasible driver
+    std::fill(didx_z.begin(), didx_z.end(), -1);
+    int64_t found = 0;
+    for (int32_t i : cand) {
+      if (found == nz) break;
+      const int32_t z = zone_id[i];
+      if (z < 0 || z >= nz || didx_z[z] >= 0) continue;
+      int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+      if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+      int32_t am[kDims];
+      for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+      int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+      if (total_z[z] - cap[i] + cwd >= k) {
+        didx_z[z] = i;
+        capd_z[z] = cwd;
+        ++found;
+      }
+    }
+
+    // per feasible zone: placement segments + exact zone score
+    int32_t best_zone = -1;
+    double best_avg = 0.0;
+    for (int64_t z = 0; z < nz; ++z) {
+      const int32_t dz = didx_z[z];
+      if (dz < 0) continue;
+      MfSegs& segs = segs_z[z];
+      segs.clear();
+      bool ok = true;
+      if (minfrag) {
+        // drain over UNCLAMPED zone capacities (vectorized pass over the
+        // per-zone eligibility bytes), driver subtracted on its node
+        select_mf_cap_pass(e)(a0.data(), a1.data(), a2.data(),
+                              zone_elig[z].data(), nb, de0, de1, de2, k,
+                              mf_caps.data());
+        if (zone_elig[z][dz]) {
+          int32_t av[kDims];
+          for (int j = 0; j < kDims; ++j)
+            av[j] = wrap_sub((j == 0 ? a0 : j == 1 ? a1 : a2)[dz], d[j]);
+          mf_caps[dz] = mf_cap_one(av[0], av[1], av[2], e);
+        }
+        if (k > 0)
+          ok = mf_assign(mf_caps, k, mf_extremes(mf_caps, k), mf_ws, segs);
+      } else if (k > 0) {
+        // tightly-pack greedy fill in node order within the zone
+        int64_t cum = 0;
+        for (int64_t i = 0; i < nb && cum < k; ++i) {
+          if (zone_id[i] != z) continue;
+          const int64_t c = (i == dz) ? capd_z[z] : cap[i];
+          if (c <= 0) continue;
+          const int64_t take = std::min<int64_t>(c, k - cum);
+          segs.emplace_back(static_cast<int32_t>(i), take);
+          cum += take;
+        }
+        ok = cum == k;  // guaranteed by the driver-choice condition
+      }
+      if (!ok) {
+        didx_z[z] = -1;
+        continue;
+      }
+      // occurrence-ordered float64 sum of per-node max efficiencies
+      // ([driver] + executor placements, single_az.go:75-97).  Under
+      // strict min-frag parity the reservation side sees only the
+      // driver (the reference's no-write-back quirk); occurrences still
+      // weight every placement.
+      const bool eff_zero = minfrag && strict;
+      double max_sum = 0.0;
+      {
+        int64_t eff_driver = 0;
+        if (!eff_zero) {
+          for (const auto& seg : segs) {
+            if (seg.first == dz) eff_driver = seg.second;
+          }
+        }
+        max_sum += node_max_eff(dz, eff_driver, d, e, true);
+      }
+      for (const auto& seg : segs) {
+        const int64_t eff_count = eff_zero ? 0 : seg.second;
+        const double v =
+            node_max_eff(seg.first, eff_count, d, e, seg.first == dz);
+        for (int64_t c = 0; c < seg.second; ++c) max_sum += v;
+      }
+      const double avg =
+          max_sum / static_cast<double>(static_cast<int64_t>(k) + 1);
+      if (best_avg < avg) {  // strict improvement, zone order
+        best_avg = avg;
+        best_zone = static_cast<int32_t>(z);
+      }
+    }
+
+    int32_t chosen_didx = -1;
+    const MfSegs* chosen_segs = nullptr;
+    MfSegs cross_segs;
+    if (best_zone >= 0) {
+      chosen_didx = didx_z[best_zone];
+      chosen_segs = &segs_z[best_zone];
+    } else if (az_aware) {
+      // cross-zone tightly-pack fallback (az_aware_pack_tightly.go:27-38)
+      int64_t total = 0;
+      for (int64_t i = 0; i < nb; ++i) total += cap[i];
+      int32_t didx = -1, capd = 0;
+      if (total >= k) {
+        for (int32_t i : cand) {
+          int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+          if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+          int32_t am[kDims];
+          for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+          int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+          if (total - cap[i] + cwd >= k) {
+            didx = i;
+            capd = cwd;
+            break;
+          }
+        }
+      }
+      if (didx >= 0) {
+        int64_t cum = 0;
+        for (int64_t i = 0; i < nb && cum < k; ++i) {
+          const int64_t c = (i == didx) ? capd : cap[i];
+          if (c <= 0) continue;
+          const int64_t take = std::min<int64_t>(c, k - cum);
+          cross_segs.emplace_back(static_cast<int32_t>(i), take);
+          cum += take;
+        }
+        chosen_didx = didx;
+        chosen_segs = &cross_segs;
+        best_zone = static_cast<int32_t>(nz);
+      }
+    }
+    if (chosen_didx < 0) continue;
+
+    out_feasible[ai] = 1;
+    out_zone[ai] = best_zone;
+    out_driver_idx[ai] = chosen_didx;
+
+    bool driver_hosts_exec = false;
+    for (const auto& seg : *chosen_segs) {
+      const int32_t i = seg.first;
+      if (i == chosen_didx) driver_hosts_exec = true;
+      a0[i] = wrap_sub(a0[i], e[0]);
+      a1[i] = wrap_sub(a1[i], e[1]);
+      a2[i] = wrap_sub(a2[i], e[2]);
+    }
+    if (!driver_hosts_exec) {
+      a0[chosen_didx] = wrap_sub(a0[chosen_didx], d[0]);
+      a1[chosen_didx] = wrap_sub(a1[chosen_didx], d[1]);
+      a2[chosen_didx] = wrap_sub(a2[chosen_didx], d[2]);
     }
   }
   for (int64_t i = 0; i < nb; ++i) {
