@@ -828,6 +828,7 @@ def main() -> None:
     # headline evidence; rewritten afterwards with SECONDARY filled in
     _write_bench_result(headline, commit=False)
     _secondary_configs()
+    _config5_e2e()
     _write_bench_result(headline)
     # the headline is the FINAL stdout line, emitted after everything
     # that could possibly crash or spew — a tail-window capture (the
@@ -974,6 +975,140 @@ def _secondary_configs() -> None:
         try:
             if h is not None:
                 h.close()
+        except Exception:
+            pass
+        logging.disable(logging.NOTSET)
+
+
+def _config5_e2e() -> None:
+    """(5) end-to-end: the north-star snapshot through the REAL HTTP
+    extender — N_NODES nodes, N_APPS pending FIFO drivers, and the
+    youngest driver's Filter measured at the request level
+    (server/http.py → serde → Predicate → tensor mirror → native/device
+    queue lane).  Proves the solver-only headline survives the full
+    request path (VERDICT r3 #5; reference path resource.go:128-183 +
+    cmd/endpoints.go:29-41)."""
+    import json as _json
+    import logging
+    import urllib.request
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    probes = int(os.environ.get("BENCH_E2E_PROBES", "25"))
+    http = scheduler = None
+    try:
+        from k8s_spark_scheduler_tpu.config import Install
+        from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+        from k8s_spark_scheduler_tpu.kube.crd import (
+            DEMAND_CRD_NAME,
+            demand_crd_spec,
+        )
+        from k8s_spark_scheduler_tpu.server.http import ExtenderHTTPServer
+        from k8s_spark_scheduler_tpu.server.wiring import init_server_with_clients
+        from k8s_spark_scheduler_tpu.testing.harness import Harness
+        from k8s_spark_scheduler_tpu.types import serde
+        from k8s_spark_scheduler_tpu.types.objects import Node, ObjectMeta
+        from k8s_spark_scheduler_tpu.types.resources import ZONE_LABEL, Resources
+
+        logging.disable(logging.WARNING)
+        t_setup = time.perf_counter()
+        api = APIServer()
+        api.create_crd(DEMAND_CRD_NAME, demand_crd_spec())
+        scheduler = init_server_with_clients(
+            api, Install(binpack_algo="tpu-batch", fifo=True),
+            demand_poll_interval=0.5,
+        )
+        rng = np.random.RandomState(5)
+        names = []
+        for i in range(N_NODES):
+            name = f"n{i:05d}"
+            names.append(name)
+            api.create(
+                Node(
+                    meta=ObjectMeta(
+                        name=name,
+                        labels={
+                            ZONE_LABEL: f"z{i % 3}",
+                            "resource_channel": "batch-medium-priority",
+                        },
+                    ),
+                    allocatable=Resources.of(
+                        str(int(rng.randint(4, 96))),
+                        f"{int(rng.randint(8, 256))}Gi",
+                    ),
+                )
+            )
+        base = time.time() - 10_000.0
+        for i in range(N_APPS):
+            d = Harness.static_allocation_spark_pods(
+                f"queue-{i:04d}",
+                int(rng.randint(1, 32)),
+                executor_cpu=str(int(rng.randint(1, 8))),
+                executor_mem=f"{int(rng.randint(2, 16))}Gi",
+                creation_timestamp=base + i,
+            )[0]
+            api.create(d)
+        probe_pods = []
+        for i in range(probes):
+            d = Harness.static_allocation_spark_pods(
+                f"probe-{i:03d}", 4, creation_timestamp=base + N_APPS + i
+            )[0]
+            probe_pods.append(api.create(d))
+        http = ExtenderHTTPServer(scheduler, port=0)
+        http.start()
+        setup_s = time.perf_counter() - t_setup
+
+        def post_filter(pod):
+            payload = {
+                "Pod": serde.pod_to_dict(pod),
+                "NodeNames": names,
+            }
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http.port}/predicates",
+                data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                body = _json.loads(resp.read())
+            return (time.perf_counter() - t0) * 1000.0, body
+
+        # warmup (compile/mirror build) then one timed request per probe
+        # driver — each leaves a reservation, so the ~N_APPS-deep pending
+        # queue is re-solved per request exactly like production Filters
+        warm_ms, _ = post_filter(probe_pods[0])
+        lat_ms = []
+        granted = 0
+        for pod in probe_pods[1:]:
+            ms, body = post_filter(pod)
+            lat_ms.append(ms)
+            granted += bool(body.get("NodeNames") or body.get("nodeNames"))
+        lat = np.array(lat_ms)
+        p99 = float(np.percentile(lat, 99))
+        stats = _lane_stats(lat, granted)
+        stats["setup_s"] = round(setup_s, 1)
+        stats["warmup_ms"] = round(warm_ms, 1)
+        LANES["config5-e2e http"] = stats
+        SECONDARY["config5_e2e_p99_ms"] = round(p99, 1)
+        SECONDARY["config5_e2e_p50_ms"] = round(float(np.percentile(lat, 50)), 1)
+        SECONDARY["config5_e2e_granted"] = granted
+        print(
+            f"# config5-e2e HTTP Filter {N_NODES}x{N_APPS}: "
+            f"p99={p99:.1f}ms p50={np.percentile(lat, 50):.1f}ms "
+            f"granted={granted}/{len(lat_ms)} warmup={warm_ms:.0f}ms "
+            f"setup={setup_s:.0f}s",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        print(f"# config5-e2e failed: {err}", file=sys.stderr)
+    finally:
+        try:
+            if http is not None:
+                http.stop()
+            if scheduler is not None:
+                scheduler.stop()
         except Exception:
             pass
         logging.disable(logging.NOTSET)

@@ -49,6 +49,14 @@ class Informer:
         self._update_handlers: List[UpdateHandler] = []
         self._delete_handlers: List[Handler] = []
         self._synced = False
+        # bumped on every applied event — consumers key derived-view
+        # caches on it (client-go's informer cache has no analog; our
+        # hot paths re-derive views per request without it)
+        self.revision = 0
+        # finer-grained: per indexed (label key, value) revisions, so a
+        # view over one label bucket (e.g. spark-role=driver) is not
+        # invalidated by churn in other buckets (executor pod events)
+        self._selector_revs: Dict[Tuple[str, str], int] = {}
 
     def start(self) -> None:
         self._api.watch(self.kind, self._on_event)
@@ -66,6 +74,7 @@ class Informer:
             if rv <= self._last_rv.get(key, -1):
                 return
             self._last_rv[key] = rv
+            self.revision += 1
             if len(self._last_rv) > self._TOMBSTONE_LIMIT:
                 # prune entries for objects we no longer mirror
                 self._last_rv = {
@@ -89,6 +98,14 @@ class Informer:
                     value = obj.labels.get(label_key)
                     if value is not None:
                         index.setdefault(value, set()).add(key)
+                touched = set()
+                if old is not None and old.labels.get(label_key) is not None:
+                    touched.add(old.labels[label_key])
+                if event != DELETED and obj.labels.get(label_key) is not None:
+                    touched.add(obj.labels[label_key])
+                for v in touched:
+                    sk = (label_key, v)
+                    self._selector_revs[sk] = self._selector_revs.get(sk, 0) + 1
             add_handlers = list(self._add_handlers)
             update_handlers = list(self._update_handlers)
             delete_handlers = list(self._delete_handlers)
@@ -142,6 +159,12 @@ class Informer:
             wrap_add(obj)
 
     # -- lister interface ----------------------------------------------------
+
+    def selector_revision(self, label_key: str, value: str) -> int:
+        """Revision of one indexed label bucket: changes only when an
+        event touched an object carrying (label_key, value)."""
+        with self._lock:
+            return self._selector_revs.get((label_key, value), 0)
 
     def list(
         self,

@@ -58,6 +58,26 @@ def _resources_to_base(r: Resources) -> Tuple[List[int], bool]:
     return out, exact
 
 
+def _app_base_rows(app) -> Tuple[List[int], List[int], bool]:
+    """(driver_row, executor_row, exact) for one AppDemand, stashed on
+    the instance: the FIFO pass re-tensorizes the same ~queue-depth apps
+    on every Filter request, and the extender serves STABLE AppDemand
+    instances per pod version (sparkpods.spark_app_demand_cached), so
+    the exact base-unit conversion runs once per app, not per request.
+    (Hash-keyed memoization was tried first — hashing three Fractions
+    costs as much as the conversion.)"""
+    rows = getattr(app, "_base_rows", None)
+    if rows is None:
+        drow, e1 = _resources_to_base(app.driver_resources)
+        erow, e2 = _resources_to_base(app.executor_resources)
+        rows = (drow, erow, e1 and e2)
+        try:
+            app._base_rows = rows
+        except AttributeError:  # frozen/slots instances: just recompute
+            pass
+    return rows
+
+
 NODE_BUCKETS = (64, 256, 1024, 4096)
 APP_BUCKETS = (16, 64, 256, 1024, 4096)
 
@@ -182,9 +202,8 @@ def tensorize_apps(apps: Sequence) -> AppTensor:
     count = np.zeros(a, dtype=np.int64)
     exact = True
     for i, app in enumerate(apps):
-        drow, e1 = _resources_to_base(app.driver_resources)
-        erow, e2 = _resources_to_base(app.executor_resources)
-        exact = exact and e1 and e2
+        drow, erow, e = _app_base_rows(app)
+        exact = exact and e
         driver[i] = drow
         executor[i] = erow
         count[i] = app.min_executor_count

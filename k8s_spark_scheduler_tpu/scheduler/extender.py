@@ -43,7 +43,9 @@ from .sparkpods import (
     AnnotationError,
     SparkPodLister,
     spark_resource_usage,
+    spark_app_demand_cached,
     spark_resources,
+    spark_resources_cached,
 )
 
 logger = logging.getLogger(__name__)
@@ -456,20 +458,16 @@ class SparkSchedulerExtender:
             if self._is_fifo:
                 for queued in self._pod_lister.list_earlier_drivers(driver):
                     try:
-                        queued_resources = spark_resources(queued)
+                        # stable AppDemand per pod version: tensor rows
+                        # are computed once per app, not per request
+                        _, demand = spark_app_demand_cached(queued)
                     except AnnotationError:
                         logger.warning(
                             "failed to get driver resources, skipping driver %s",
                             queued.name,
                         )
                         continue
-                    earlier_apps.append(
-                        AppDemand(
-                            queued_resources.driver_resources,
-                            queued_resources.executor_resources,
-                            queued_resources.min_executor_count,
-                        )
-                    )
+                    earlier_apps.append(demand)
                     skip_allowed.append(
                         self._should_skip_driver_fifo(queued, instance_group)
                     )
@@ -511,19 +509,13 @@ class SparkSchedulerExtender:
         skip_allowed = []
         for queued in queued_drivers:
             try:
-                queued_resources = spark_resources(queued)
+                _, demand = spark_app_demand_cached(queued)
             except AnnotationError:
                 logger.warning(
                     "failed to get driver resources, skipping driver %s", queued.name
                 )
                 continue
-            earlier_apps.append(
-                AppDemand(
-                    queued_resources.driver_resources,
-                    queued_resources.executor_resources,
-                    queued_resources.min_executor_count,
-                )
-            )
+            earlier_apps.append(demand)
             skip_allowed.append(self._should_skip_driver_fifo(queued, instance_group))
         try:
             outcome = solver.solve(
@@ -563,7 +555,7 @@ class SparkSchedulerExtender:
         its usage before considering this one."""
         for driver in drivers:
             try:
-                app_resources = spark_resources(driver)
+                app_resources = spark_resources_cached(driver)
             except AnnotationError:
                 logger.warning("failed to get driver resources, skipping driver %s", driver.name)
                 continue

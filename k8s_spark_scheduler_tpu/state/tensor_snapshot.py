@@ -31,6 +31,7 @@ mutation sequences).
 
 from __future__ import annotations
 
+import itertools
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
@@ -71,6 +72,12 @@ class TensorSnapshot:
     # topology changes so per-request orderings never sort object arrays
     name_rank: np.ndarray            # [N] int64
 
+    # (maintainer instance, structure revision): changes whenever the
+    # node TABLE changes (add/remove/labels/zone/ready/unschedulable —
+    # not usage), letting per-request consumers cache structure-derived
+    # work (ops/fast_path._build_prep) across Filter requests
+    structure_key: tuple = (-1, -1)
+
     _name_index: Optional[Dict[str, int]] = None
 
     @property
@@ -89,10 +96,18 @@ class TensorSnapshot:
         return self._name_index
 
 
+_INSTANCE_SEQ = itertools.count()
+
+
 class TensorSnapshotCache:
     def __init__(self, node_informer, pod_informer, rr_cache, soft_store):
         self._lock = threading.RLock()
         self._exact = True
+        # cache-instance id + structure revision (see TensorSnapshot.
+        # structure_key); instance ids are process-unique so revisions
+        # from different maintainers can never alias in consumer caches
+        self._instance_id = next(_INSTANCE_SEQ)
+        self._structure_rev = 0
 
         # node table
         self._node_slot: Dict[str, int] = {}
@@ -175,6 +190,16 @@ class TensorSnapshotCache:
     def _on_node(self, node: Node) -> None:
         with self._lock:
             slot = self._node_slot.get(node.name)
+            new_zone = self._zone_of(node.labels)
+            if slot is None or (
+                self._labels[slot] != node.labels
+                or self._zone_id[slot] != new_zone
+                or bool(self._ready[slot]) != node.ready
+                or bool(self._unsched[slot]) != node.unschedulable
+            ):
+                # structural change only: allocatable/status heartbeats
+                # must not invalidate structure-keyed consumer caches
+                self._structure_rev += 1
             if slot is None:
                 slot = self._free_nodes.pop() if self._free_nodes else self._grow_nodes()
                 self._node_slot[node.name] = slot
@@ -187,13 +212,14 @@ class TensorSnapshotCache:
             if not exact:
                 self._exact = False
             self._alloc[slot] = row
-            self._zone_id[slot] = self._zone_of(node.labels)
+            self._zone_id[slot] = new_zone
             self._ready[slot] = node.ready
             self._unsched[slot] = node.unschedulable
             self._labels[slot] = dict(node.labels)
 
     def _on_node_delete(self, node: Node) -> None:
         with self._lock:
+            self._structure_rev += 1
             slot = self._node_slot.pop(node.name, None)
             if slot is None:
                 return
@@ -394,4 +420,5 @@ class TensorSnapshotCache:
                 exact=self._exact,
                 res_entries=self._res_count[idx] > 0,  # comparison allocates fresh
                 name_rank=self._name_rank[idx].copy(),
+                structure_key=(self._instance_id, self._structure_rev),
             )
