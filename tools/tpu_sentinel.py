@@ -127,6 +127,23 @@ def git_commit_paths(paths: list[str], message: str) -> bool:
     return False
 
 
+def _foreign_bench_running() -> bool:
+    """True when a bench.py process not started by this sentinel is
+    alive (pgrep is present on this image; fail open if not)."""
+    try:
+        # anchored: only a process whose COMMAND is python running
+        # bench.py, interpreter flags allowed (the driver harness
+        # mentions "bench.py" deep in its own argv and must not match)
+        out = subprocess.run(
+            ["pgrep", "-f",
+             "^[^ ]*python[0-9.]*( -[^ ]+)* [^ ]*bench\\.py"],
+            capture_output=True, text=True, timeout=10,
+        )
+        return bool(out.stdout.strip())
+    except Exception:
+        return False
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=300.0,
@@ -142,7 +159,19 @@ def main() -> int:
     os.makedirs(os.path.join(REPO, "logs"), exist_ok=True)
     stop_at = time.monotonic() + args.max_hours * 3600.0
     probe_n = 0
+    skips = 0
     while time.monotonic() < stop_at:
+        # yield to a foreign bench run: a probe subprocess (jax init,
+        # up to probe-timeout seconds of CPU) would contaminate its
+        # latency percentiles on the single-core dev host.  Bounded: a
+        # wedged/orphaned bench must not starve the sentinel of its
+        # whole window (probing is the sentinel's entire purpose).
+        if skips < 5 and _foreign_bench_running():
+            skips += 1
+            log(f"bench in progress elsewhere; skipping probe ({skips}/5)")
+            time.sleep(args.interval)
+            continue
+        skips = 0
         probe_n += 1
         backend = probe_default_backend(args.probe_timeout)
         if backend and "tpu" in backend:
