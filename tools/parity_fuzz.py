@@ -153,34 +153,59 @@ def queue_fuzz(rng, metadata, driver_order, executor_order, report):
     )
     from k8s_spark_scheduler_tpu.ops.sparkapp import AppDemand
 
-    queue_pairs = [
-        ("queue/tightly-pack", TpuFifoSolver("tightly-pack"), packers.tightly_pack),
-        (
-            "queue/distribute-evenly",
-            TpuFifoSolver("distribute-evenly"),
-            packers.distribute_evenly,
-        ),
-        (
-            "queue/minimal-fragmentation",
-            TpuFifoSolver("minimal-fragmentation"),
-            packers.minimal_fragmentation_pack,
-        ),
-        (
-            "queue/single-az",
-            TpuSingleAzFifoSolver(az_aware=False),
-            packers.single_az_tightly_pack,
-        ),
-        (
-            "queue/az-aware",
-            TpuSingleAzFifoSolver(az_aware=True),
-            packers.az_aware_tightly_pack,
-        ),
-        (
-            "queue/single-az-minimal-fragmentation",
-            TpuSingleAzFifoSolver(inner_policy="minimal-fragmentation"),
-            packers.single_az_minimal_fragmentation,
-        ),
-    ]
+    # every policy × both serving lanes: "native" forces the C++
+    # solvers (raising loudly if the toolchain is missing, so the lane
+    # can never silently degrade to an XLA re-run and fuzz green with
+    # zero native coverage), "xla" forces the fused device scans — both
+    # against the same host oracle
+    from k8s_spark_scheduler_tpu.native.fifo import native_fifo_available
+
+    backends = ["xla"]
+    if native_fifo_available():
+        backends.insert(0, "native")
+    else:
+        print(
+            "WARNING: native C++ solver unavailable — fuzzing the XLA "
+            "lane only (no native differential coverage this run)",
+            file=sys.stderr,
+        )
+    queue_pairs = []
+    for backend in backends:
+        tag = f"queue[{backend}]"
+        queue_pairs += [
+            (
+                f"{tag}/tightly-pack",
+                TpuFifoSolver("tightly-pack", backend=backend),
+                packers.tightly_pack,
+            ),
+            (
+                f"{tag}/distribute-evenly",
+                TpuFifoSolver("distribute-evenly", backend=backend),
+                packers.distribute_evenly,
+            ),
+            (
+                f"{tag}/minimal-fragmentation",
+                TpuFifoSolver("minimal-fragmentation", backend=backend),
+                packers.minimal_fragmentation_pack,
+            ),
+            (
+                f"{tag}/single-az",
+                TpuSingleAzFifoSolver(az_aware=False, backend=backend),
+                packers.single_az_tightly_pack,
+            ),
+            (
+                f"{tag}/az-aware",
+                TpuSingleAzFifoSolver(az_aware=True, backend=backend),
+                packers.az_aware_tightly_pack,
+            ),
+            (
+                f"{tag}/single-az-minimal-fragmentation",
+                TpuSingleAzFifoSolver(
+                    inner_policy="minimal-fragmentation", backend=backend
+                ),
+                packers.single_az_minimal_fragmentation,
+            ),
+        ]
     n_nodes = len(metadata)
     queue = [random_gang(rng, n_nodes) for _ in range(rng.randint(1, 6))]
     current = random_gang(rng, n_nodes)
