@@ -50,12 +50,16 @@ class Informer:
         self._delete_handlers: List[Handler] = []
         self._synced = False
         # bumped on every applied event — consumers key derived-view
-        # caches on it (client-go's informer cache has no analog; our
-        # hot paths re-derive views per request without it)
+        # caches on it, directly or via selector_revision (client-go's
+        # informer cache has no analog; our hot paths re-derive views
+        # per request without it)
         self.revision = 0
         # finer-grained: per indexed (label key, value) revisions, so a
         # view over one label bucket (e.g. spark-role=driver) is not
-        # invalidated by churn in other buckets (executor pod events)
+        # invalidated by churn in other buckets (executor pod events).
+        # Values are global-revision stamps (monotone even across the
+        # bounded prune below); unindexed keys fall back to the global
+        # revision so a consumer cache can never silently freeze.
         self._selector_revs: Dict[Tuple[str, str], int] = {}
 
     def start(self) -> None:
@@ -104,8 +108,16 @@ class Informer:
                 if event != DELETED and obj.labels.get(label_key) is not None:
                     touched.add(obj.labels[label_key])
                 for v in touched:
-                    sk = (label_key, v)
-                    self._selector_revs[sk] = self._selector_revs.get(sk, 0) + 1
+                    # stamp with the global revision: monotone and
+                    # collision-free even after a prune (a pruned bucket
+                    # reads 0, then restarts above any stamp a consumer
+                    # could have cached)
+                    self._selector_revs[(label_key, v)] = self.revision
+                if len(self._selector_revs) > self._TOMBSTONE_LIMIT:
+                    # unbounded-value labels (spark-app-id) would leak an
+                    # entry per app forever; a full clear is safe — every
+                    # consumer sees 0 ≠ its cached stamp and recomputes
+                    self._selector_revs.clear()
             add_handlers = list(self._add_handlers)
             update_handlers = list(self._update_handlers)
             delete_handlers = list(self._delete_handlers)
@@ -162,8 +174,13 @@ class Informer:
 
     def selector_revision(self, label_key: str, value: str) -> int:
         """Revision of one indexed label bucket: changes only when an
-        event touched an object carrying (label_key, value)."""
+        event touched an object carrying (label_key, value).  For a key
+        the informer does NOT index, falls back to the global revision —
+        coarser invalidation, but a derived-view cache can never freeze
+        on a permanently-stale bucket."""
         with self._lock:
+            if label_key not in self._indexes:
+                return self.revision
             return self._selector_revs.get((label_key, value), 0)
 
     def list(

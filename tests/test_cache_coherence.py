@@ -1,0 +1,163 @@
+"""Coherence of the round-4 request-path caches: every cache is keyed
+by a revision that must change when (and only when) the underlying state
+changes, so a stale entry can never alter a scheduling decision.
+
+Covers: the build_cluster_tensor structural prep cache (fast_path),
+the pending-FIFO-driver view (sparkpods + informer selector revisions),
+the per-pod-version demand parse cache, and the structural-revision
+bump discipline in the tensor snapshot."""
+
+import time
+
+import pytest
+
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+
+@pytest.fixture
+def h():
+    harness = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    yield harness
+    harness.close()
+
+
+def _nodes(h, n=4, instance_group="batch-medium-priority"):
+    names = []
+    for i in range(n):
+        name = f"n{i:02d}"
+        h.new_node(name, cpu="16", memory="32Gi", instance_group=instance_group)
+        names.append(name)
+    return names
+
+
+def test_prep_cache_sees_node_label_change(h):
+    """A node that leaves the instance group after a cached Filter must
+    stop being a candidate on the next Filter (structure_rev bump →
+    prep recompute)."""
+    names = _nodes(h, 2)
+    pods = Harness.static_allocation_spark_pods("app-a", 1)
+    res = h.schedule(pods[0], names)
+    assert res.node_names
+
+    # move BOTH nodes out of the instance group
+    for name in names:
+        node = h.api.get("Node", "default", name)
+        node.meta.labels["resource_channel"] = "other-group"
+        h.api.update(node)
+
+    pods2 = Harness.static_allocation_spark_pods("app-b", 1)
+    res2 = h.schedule(pods2[0], names)
+    assert not res2.node_names, "stale prep cache admitted an ineligible node"
+
+
+def test_prep_cache_reused_on_usage_only_change(h):
+    """Reservations/usage changes must NOT bump the structure revision:
+    consecutive Filters over an unchanged node table reuse the cached
+    prework (the whole point of the cache)."""
+    from k8s_spark_scheduler_tpu.ops import fast_path
+
+    names = _nodes(h, 4)
+    h.schedule(Harness.static_allocation_spark_pods("warm", 1)[0], names)
+    snap1 = h.server.tensor_snapshot.snapshot()
+    # scheduling wrote a reservation (usage change, not structure)
+    h.schedule(Harness.static_allocation_spark_pods("next", 1)[0], names)
+    snap2 = h.server.tensor_snapshot.snapshot()
+    assert snap1.structure_key == snap2.structure_key, (
+        "usage-only change bumped the structure revision"
+    )
+    # and the prep cache holds an entry for that structure revision
+    with fast_path._prep_lock:
+        assert any(
+            key[0] == snap2.structure_key for key in fast_path._PREP_CACHE
+        )
+
+
+def test_pending_queue_cache_sees_new_and_deleted_drivers(h):
+    """The pending-driver view must reflect driver pod churn immediately
+    (selector-revision keying): a blocking earlier driver disappearing
+    unblocks the current driver."""
+    names = _nodes(h, 1)  # single 16-cpu node
+    base = time.time()
+    # an older ENFORCED driver whose gang (1 + 20x1cpu > 16 cpus) can
+    # never fit: an enforced earlier driver that does not fit fails
+    # every younger driver's Filter (resource.go:244-253)
+    blocker = Harness.static_allocation_spark_pods(
+        "blocker", 20, creation_timestamp=base - 500
+    )[0]
+    h.create_pod(blocker)
+    current = Harness.static_allocation_spark_pods(
+        "current", 1, creation_timestamp=base
+    )[0]
+    h.create_pod(current)
+    res = h.schedule(current, names)
+    assert not res.node_names, "earlier enforced driver should block"
+
+    # delete the blocker; the same Filter must now succeed
+    h.delete_pod(blocker)
+    res2 = h.schedule(current, names)
+    assert res2.node_names, "stale pending-driver cache kept a deleted blocker"
+
+
+def test_demand_parse_cache_tracks_annotation_update(h):
+    """A driver pod whose annotations change (new resourceVersion) must
+    be re-parsed: the queue pass sees the NEW executor count."""
+    names = _nodes(h, 1)
+    base = time.time()
+    small = Harness.static_allocation_spark_pods(
+        "grower", 1, creation_timestamp=base - 500
+    )[0]
+    created = h.create_pod(small)
+    # warm the parse cache via a Filter for a younger driver
+    younger = Harness.static_allocation_spark_pods(
+        "younger", 1, creation_timestamp=base
+    )[0]
+    h.create_pod(younger)
+    assert h.schedule(younger, names).node_names
+
+    # grow the earlier driver's gang beyond the node (16 cpu): 1 driver
+    # + 20 executors can never fit, and enforced earlier drivers that
+    # don't fit fail the current driver's Filter
+    fresh = h.api.get("Pod", "default", created.name)
+    fresh.meta.annotations["spark-executor-count"] = "20"
+    h.api.update(fresh)
+
+    third = Harness.static_allocation_spark_pods(
+        "third", 1, creation_timestamp=base + 1
+    )[0]
+    res = h.schedule(third, names)
+    assert not res.node_names, (
+        "stale demand cache still used the old executor count"
+    )
+
+
+def test_selector_revision_unindexed_falls_back_to_global():
+    """An informer with NO index for the label must still report change
+    (global-revision fallback) — a derived-view cache keyed on it can
+    never freeze."""
+    from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+    from k8s_spark_scheduler_tpu.kube.informer import Informer
+    from k8s_spark_scheduler_tpu.types.objects import ObjectMeta, Pod
+
+    api = APIServer()
+    inf = Informer(api, Pod.KIND)  # no index_labels
+    inf.start()
+    rev0 = inf.selector_revision("spark-role", "driver")
+    api.create(
+        Pod(meta=ObjectMeta(name="p1", labels={"spark-role": "driver"}))
+    )
+    assert inf.selector_revision("spark-role", "driver") > rev0
+
+
+def test_selector_revision_ignores_other_buckets(h):
+    """Executor-pod churn must not invalidate the driver-bucket view."""
+    informer = h.server.pod_informer
+    rev_before = informer.selector_revision("spark-role", "driver")
+    # executor pods churn (different role bucket)
+    pods = Harness.static_allocation_spark_pods("churn", 2)
+    for p in pods[1:]:
+        h.create_pod(p)
+        h.delete_pod(p)
+    assert informer.selector_revision("spark-role", "driver") == rev_before
+    # a driver event does bump it
+    h.create_pod(pods[0])
+    assert informer.selector_revision("spark-role", "driver") > rev_before
