@@ -31,6 +31,10 @@ class Informer:
     # bound on remembered last-seen resourceVersions for departed objects
     # (guards against a late stale MODIFIED resurrecting a deleted object)
     _TOMBSTONE_LIMIT = 16384
+    # bound on per-(label, value) selector revision stamps (unbounded-
+    # value labels like spark-app-id would otherwise leak one entry per
+    # application for the life of the process)
+    _SELECTOR_REVS_LIMIT = 16384
 
     def __init__(self, api: APIServer, kind: str, index_labels: Tuple[str, ...] = ()):
         self._api = api
@@ -61,6 +65,11 @@ class Informer:
         # bounded prune below); unindexed keys fall back to the global
         # revision so a consumer cache can never silently freeze.
         self._selector_revs: Dict[Tuple[str, str], int] = {}
+        # floor returned for missing buckets: bumped to the global
+        # revision whenever _selector_revs is pruned, so a cleared
+        # bucket can never read a value a consumer might have cached
+        # (0 would repeat across clears and freeze a stale view)
+        self._selector_floor = 0
 
     def start(self) -> None:
         self._api.watch(self.kind, self._on_event)
@@ -113,11 +122,13 @@ class Informer:
                     # reads 0, then restarts above any stamp a consumer
                     # could have cached)
                     self._selector_revs[(label_key, v)] = self.revision
-                if len(self._selector_revs) > self._TOMBSTONE_LIMIT:
+                if len(self._selector_revs) > self._SELECTOR_REVS_LIMIT:
                     # unbounded-value labels (spark-app-id) would leak an
-                    # entry per app forever; a full clear is safe — every
-                    # consumer sees 0 ≠ its cached stamp and recomputes
+                    # entry per app forever; a full clear is safe because
+                    # the floor rises to the current revision — strictly
+                    # above every stamp a consumer could have cached
                     self._selector_revs.clear()
+                    self._selector_floor = self.revision
             add_handlers = list(self._add_handlers)
             update_handlers = list(self._update_handlers)
             delete_handlers = list(self._delete_handlers)
@@ -181,7 +192,7 @@ class Informer:
         with self._lock:
             if label_key not in self._indexes:
                 return self.revision
-            return self._selector_revs.get((label_key, value), 0)
+            return self._selector_revs.get((label_key, value), self._selector_floor)
 
     def list(
         self,

@@ -148,6 +148,37 @@ def test_selector_revision_unindexed_falls_back_to_global():
     assert inf.selector_revision("spark-role", "driver") > rev0
 
 
+def test_selector_revision_monotone_across_prune(monkeypatch):
+    """Pruning _selector_revs must never hand a consumer a stamp it
+    could have cached before (the 0-collision freeze class): reads are
+    monotone, and a bucket event wiped by a prune still invalidates."""
+    from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+    from k8s_spark_scheduler_tpu.kube.informer import Informer
+    from k8s_spark_scheduler_tpu.types.objects import ObjectMeta, Pod
+
+    monkeypatch.setattr(Informer, "_SELECTOR_REVS_LIMIT", 4)
+    api = APIServer()
+    inf = Informer(api, Pod.KIND, index_labels=("spark-role", "spark-app-id"))
+    inf.start()
+
+    def churn(n, tag):
+        for i in range(n):
+            api.create(Pod(meta=ObjectMeta(
+                name=f"{tag}-{i}", labels={"spark-app-id": f"{tag}-{i}"})))
+
+    api.create(Pod(meta=ObjectMeta(name="d1", labels={"spark-role": "driver"})))
+    seen = [inf.selector_revision("spark-role", "driver")]
+    churn(8, "a")  # crosses the limit → prune (driver stamp wiped)
+    seen.append(inf.selector_revision("spark-role", "driver"))
+    # a driver event whose stamp is immediately pruned away must STILL
+    # change the read value (the floor rose past it)
+    api.create(Pod(meta=ObjectMeta(name="d2", labels={"spark-role": "driver"})))
+    churn(8, "b")
+    seen.append(inf.selector_revision("spark-role", "driver"))
+    assert seen == sorted(seen), f"non-monotone reads: {seen}"
+    assert seen[2] > seen[1], "prune swallowed a driver event"
+
+
 def test_selector_revision_ignores_other_buckets(h):
     """Executor-pod churn must not invalidate the driver-bucket view."""
     informer = h.server.pod_informer

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Sustained churn soak over the real HTTP extender: full app lifecycle
+(driver Filter → bind → executor Filters → run → terminate → delete)
+with node-table churn (relabels, cordon/uncordon) and annotation
+updates interleaved — the workload shape that would expose staleness in
+the round-4 revision-keyed caches or leaks in the bounded stores.
+
+    python tools/soak.py --minutes 15 --nodes 200
+
+Exit 0 only if: every driver Filter in a schedulable phase succeeds,
+reservations drain back to zero at the end, the bounded caches stayed
+bounded, and RSS growth over the steady-state window is modest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predicates",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        body = json.loads(resp.read())
+    return (time.perf_counter() - t0) * 1000.0, body
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=15.0)
+    ap.add_argument("--nodes", type=int, default=200)
+    ap.add_argument("--executors", type=int, default=3)
+    ap.add_argument("--backlog", type=int, default=30,
+                    help="standing pending drivers (never bound): every "
+                    "Filter runs a real earlier-drivers queue pass, so "
+                    "the per-pod-version parse cache is exercised")
+    args = ap.parse_args()
+
+    import logging
+
+    logging.disable(logging.WARNING)
+
+    from k8s_spark_scheduler_tpu.config import Install
+    from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+    from k8s_spark_scheduler_tpu.kube.crd import DEMAND_CRD_NAME, demand_crd_spec
+    from k8s_spark_scheduler_tpu.ops import fast_path
+    from k8s_spark_scheduler_tpu.scheduler import sparkpods
+    from k8s_spark_scheduler_tpu.server.http import ExtenderHTTPServer
+    from k8s_spark_scheduler_tpu.server.wiring import init_server_with_clients
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+    from k8s_spark_scheduler_tpu.types import serde
+    from k8s_spark_scheduler_tpu.types.objects import Node, ObjectMeta, PodPhase
+    from k8s_spark_scheduler_tpu.types.resources import ZONE_LABEL, Resources
+
+    api = APIServer()
+    api.create_crd(DEMAND_CRD_NAME, demand_crd_spec())
+    scheduler = init_server_with_clients(
+        api, Install(binpack_algo="tpu-batch", fifo=True), demand_poll_interval=0.2
+    )
+    http = ExtenderHTTPServer(scheduler, port=0)
+    http.start()
+
+    rng = np.random.RandomState(11)
+    names = []
+    for i in range(args.nodes):
+        name = f"n{i:04d}"
+        names.append(name)
+        api.create(
+            Node(
+                meta=ObjectMeta(
+                    name=name,
+                    labels={
+                        ZONE_LABEL: f"z{i % 3}",
+                        "resource_channel": "batch-medium-priority",
+                    },
+                ),
+                allocatable=Resources.of("16", "32Gi"),
+            )
+        )
+
+    # standing backlog: old (enforced) but FEASIBLE pending drivers that
+    # are never bound — each cycle's Filters repack them first
+    backlog_base = time.time() - 10_000.0
+    for i in range(args.backlog):
+        api.create(
+            Harness.static_allocation_spark_pods(
+                f"backlog-{i:03d}", 1, creation_timestamp=backlog_base + i
+            )[0]
+        )
+
+    deadline = time.time() + args.minutes * 60.0
+    cycle = 0
+    lat_ms = []
+    failures = 0
+    rss_marks = []
+    t_report = time.time()
+    while time.time() < deadline:
+        cycle += 1
+        app_id = f"soak-{cycle:06d}"
+        pods = Harness.static_allocation_spark_pods(
+            app_id, args.executors,
+            executor_cpu=str(int(rng.randint(1, 4))),
+            executor_mem=f"{int(rng.randint(1, 4))}Gi",
+        )
+        driver = api.create(pods[0])
+        ms, body = _post(http.port, {
+            "Pod": serde.pod_to_dict(driver), "NodeNames": names,
+        })
+        lat_ms.append(ms)
+        if not body.get("NodeNames"):
+            failures += 1
+            print(f"cycle {cycle}: driver Filter FAILED: {body}", file=sys.stderr)
+        else:
+            bound = api.get("Pod", "default", driver.name)
+            bound.node_name = body["NodeNames"][0]
+            bound.phase = PodPhase.RUNNING
+            api.update(bound)
+            for p in pods[1:]:
+                created = api.create(p)
+                ems, ebody = _post(http.port, {
+                    "Pod": serde.pod_to_dict(created), "NodeNames": names,
+                })
+                lat_ms.append(ems)
+                if ebody.get("NodeNames"):
+                    b = api.get("Pod", "default", created.name)
+                    b.node_name = ebody["NodeNames"][0]
+                    b.phase = PodPhase.RUNNING
+                    api.update(b)
+        # terminate + delete the whole app (reservation must GC)
+        for p in pods:
+            try:
+                fresh = api.get("Pod", "default", p.name)
+                fresh.phase = PodPhase.SUCCEEDED
+                fresh.container_terminated = [True] * max(1, len(fresh.containers))
+                api.update(fresh)
+                api.delete("Pod", "default", p.name)
+            except Exception:
+                pass
+
+        # node-table churn: relabel one node in/out of the group every
+        # 25 cycles, cordon/uncordon every 40 — exercises structure_rev
+        if cycle % 25 == 0:
+            node = api.get("Node", "default", names[cycle % args.nodes])
+            cur = node.meta.labels.get("resource_channel")
+            node.meta.labels["resource_channel"] = (
+                "other" if cur == "batch-medium-priority" else "batch-medium-priority"
+            )
+            api.update(node)
+        if cycle % 40 == 0:
+            node = api.get("Node", "default", names[(cycle * 7) % args.nodes])
+            node.unschedulable = not node.unschedulable
+            api.update(node)
+
+        if time.time() - t_report > 60:
+            t_report = time.time()
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            rss_marks.append(rss)
+            lat = np.array(lat_ms[-2000:])
+            print(
+                f"# {cycle} cycles, p50={np.percentile(lat, 50):.1f}ms "
+                f"p99={np.percentile(lat, 99):.1f}ms failures={failures} "
+                f"rss={rss // 1024}MB "
+                f"prep_cache={len(fast_path._PREP_CACHE)} "
+                f"parse_cache={len(sparkpods._SPARK_RESOURCES_CACHE)}",
+                flush=True,
+            )
+
+    # settle, then check invariants
+    time.sleep(3.0)
+    rrs = api.list("ResourceReservation")
+    lat = np.array(lat_ms)
+    prep_n = len(fast_path._PREP_CACHE)
+    parse_n = len(sparkpods._SPARK_RESOURCES_CACHE)
+    from k8s_spark_scheduler_tpu.kube.informer import Informer
+
+    sel_n = len(scheduler.pod_informer._selector_revs)
+    # steady-state RSS growth (skip the first mark: warmup/compile)
+    rss_growth_mb = (
+        (rss_marks[-1] - rss_marks[1]) // 1024 if len(rss_marks) > 2 else 0
+    )
+    ok = (
+        failures == 0
+        and len(rrs) == 0
+        and prep_n <= fast_path._PREP_CACHE_MAX
+        and parse_n <= sparkpods._SPARK_RESOURCES_CACHE_MAX
+        and sel_n <= Informer._SELECTOR_REVS_LIMIT
+        and rss_growth_mb < 200
+    )
+    print(json.dumps({
+        "cycles": cycle,
+        "requests": len(lat_ms),
+        "p50_ms": round(float(np.percentile(lat, 50)), 1),
+        "p99_ms": round(float(np.percentile(lat, 99)), 1),
+        "failures": failures,
+        "leftover_reservations": len(rrs),
+        "prep_cache": prep_n,
+        "parse_cache": parse_n,
+        "selector_revs": sel_n,
+        "steady_rss_growth_mb": rss_growth_mb,
+        "ok": bool(ok),
+    }))
+    http.stop()
+    scheduler.stop()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
