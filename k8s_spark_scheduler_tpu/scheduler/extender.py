@@ -456,6 +456,7 @@ class SparkSchedulerExtender:
             earlier_apps = []
             skip_allowed = []
             if self._is_fifo:
+                skip_cutoff = self._fifo_skip_cutoff(instance_group)
                 for queued in self._pod_lister.list_earlier_drivers(driver):
                     try:
                         # stable AppDemand per pod version: tensor rows
@@ -468,9 +469,7 @@ class SparkSchedulerExtender:
                         )
                         continue
                     earlier_apps.append(demand)
-                    skip_allowed.append(
-                        self._should_skip_driver_fifo(queued, instance_group)
-                    )
+                    skip_allowed.append(queued.creation_timestamp > skip_cutoff)
             outcome = solver.solve_tensor(
                 cluster,
                 earlier_apps,
@@ -507,6 +506,7 @@ class SparkSchedulerExtender:
 
         earlier_apps = []
         skip_allowed = []
+        skip_cutoff = self._fifo_skip_cutoff(instance_group)
         for queued in queued_drivers:
             try:
                 _, demand = spark_app_demand_cached(queued)
@@ -516,7 +516,7 @@ class SparkSchedulerExtender:
                 )
                 continue
             earlier_apps.append(demand)
-            skip_allowed.append(self._should_skip_driver_fifo(queued, instance_group))
+            skip_allowed.append(queued.creation_timestamp > skip_cutoff)
         try:
             outcome = solver.solve(
                 metadata,
@@ -588,11 +588,18 @@ class SparkSchedulerExtender:
 
     def _should_skip_driver_fifo(self, pod: Pod, instance_group: str) -> bool:
         """resource.go:264-270."""
-        enforce_after = self._fifo_config.default_enforce_after_pod_age
+        return pod.creation_timestamp > self._fifo_skip_cutoff(instance_group)
+
+    def _fifo_skip_cutoff(self, instance_group: str) -> float:
+        """Creation-time cutoff above which a queued driver is young
+        enough to skip — hoistable out of the per-request queue loop
+        (one clock sample per request instead of one per queued pod;
+        the reference's per-pod time.Now() drift within a request is
+        sub-millisecond wall clock, not decision semantics)."""
         enforce_after = self._fifo_config.enforce_after_pod_age_by_instance_group.get(
-            instance_group, enforce_after
+            instance_group, self._fifo_config.default_enforce_after_pod_age
         )
-        return pod.creation_timestamp + enforce_after > time.time()
+        return time.time() - enforce_after
 
     # -- executor path -------------------------------------------------------
 
