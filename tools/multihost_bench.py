@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Two-process multihost solve timing (the DCN lane of SURVEY §2.10):
+both processes join a jax.distributed coordinator, build one global
+mesh (4 virtual CPU devices each → 8), and time the sharded whole-queue
+solve per step.  On real hardware the same code path rides ICI/DCN; on
+virtual CPU the numbers quantify the collective overhead the
+single-process scaling curve (dryrun_multichip) can't see —
+cross-process collectives go through the gloo/grpc backend.
+
+    python tools/multihost_bench.py [--nodes 1024] [--apps 16]
+
+Prints one JSON line from process 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from k8s_spark_scheduler_tpu.parallel import mesh as meshlib
+
+    meshlib.initialize_multihost(
+        coordinator_address="127.0.0.1:" + sys.argv[2],
+        num_processes=2,
+        process_id=int(sys.argv[1]),
+    )
+    import numpy as np
+
+    assert len(jax.devices()) == 8
+    import __graft_entry__ as g
+    from k8s_spark_scheduler_tpu.models.gang_packer import GangPacker, GangPackerConfig
+
+    nodes, apps = int(sys.argv[3]), int(sys.argv[4])
+    packer = GangPacker(GangPackerConfig(use_mesh=True), devices=list(jax.devices()))
+    problem = g._example_problem(
+        n_nodes=nodes, n_apps=apps,
+        node_bucket=meshlib.pad_to_multiple(max(nodes, 64), 8), app_bucket=None,
+    )
+    t0 = time.perf_counter()
+    out = packer.solve(problem)
+    jax.block_until_ready(out.avail_after)
+    compile_s = time.perf_counter() - t0
+    steps = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = packer.solve(problem)
+        jax.block_until_ready(out.avail_after)
+        steps.append((time.perf_counter() - t0) * 1000.0)
+    if int(sys.argv[1]) == 0:
+        print("MULTIHOST_BENCH " + json.dumps({{
+            "processes": 2,
+            "devices": 8,
+            "nodes": nodes,
+            "apps": apps,
+            "feasible": int(np.asarray(out.feasible).sum()),
+            "compile_s": round(compile_s, 1),
+            "step_ms_best": round(min(steps), 1),
+            "step_ms": [round(sm, 1) for sm in steps],
+        }}), flush=True)
+    """
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1024)
+    ap.add_argument("--apps", type=int, default=16)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = str(s.getsockname()[1])
+    s.close()
+
+    script = os.path.join("/tmp", f"mh_bench_worker_{os.getpid()}.py")
+    with open(script, "w") as f:
+        f.write(WORKER.format(repo=REPO))
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, str(pid), port, str(args.nodes), str(args.apps)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    deadline = time.time() + args.timeout
+    result = None
+    for p in procs:
+        remaining = max(deadline - time.time(), 1.0)
+        try:
+            out, _ = p.communicate(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        for line in (out or "").splitlines():
+            if line.startswith("MULTIHOST_BENCH "):
+                result = line[len("MULTIHOST_BENCH "):]
+    if result is None:
+        print("multihost bench failed (no result line)", file=sys.stderr)
+        return 1
+    print(result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
