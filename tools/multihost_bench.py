@@ -84,37 +84,59 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=600.0)
     args = ap.parse_args()
 
+    # ephemeral-port pick (bind-close-reuse) can race a foreign process
+    # claiming the port before the coordinator binds it — rare on a dev
+    # host; a failed run prints both workers' output, so a port clash
+    # is visible and a re-run picks a fresh port
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = str(s.getsockname()[1])
     s.close()
 
-    script = os.path.join("/tmp", f"mh_bench_worker_{os.getpid()}.py")
-    with open(script, "w") as f:
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", prefix="mh_bench_worker_", delete=False
+    ) as f:
         f.write(WORKER.format(repo=REPO))
+        script = f.name
 
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, script, str(pid), port, str(args.nodes), str(args.apps)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        for pid in (0, 1)
-    ]
-    deadline = time.time() + args.timeout
-    result = None
-    for p in procs:
-        remaining = max(deadline - time.time(), 1.0)
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(pid), port,
+                 str(args.nodes), str(args.apps)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for pid in (0, 1)
+        ]
+        deadline = time.time() + args.timeout
+        result = None
+        outputs = []
+        for p in procs:
+            remaining = max(deadline - time.time(), 1.0)
+            try:
+                out, _ = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outputs.append((p.returncode, out or ""))
+            for line in (out or "").splitlines():
+                if line.startswith("MULTIHOST_BENCH "):
+                    result = line[len("MULTIHOST_BENCH "):]
+    finally:
         try:
-            out, _ = p.communicate(timeout=remaining)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            out, _ = p.communicate()
-        for line in (out or "").splitlines():
-            if line.startswith("MULTIHOST_BENCH "):
-                result = line[len("MULTIHOST_BENCH "):]
+            os.unlink(script)
+        except OSError:
+            pass
     if result is None:
+        # surface the worker tracebacks — a bare failure line is
+        # undebuggable
+        for i, (rc, out) in enumerate(outputs):
+            print(f"--- worker {i} rc={rc} ---\n{out[-2000:]}", file=sys.stderr)
         print("multihost bench failed (no result line)", file=sys.stderr)
         return 1
     print(result)
