@@ -716,6 +716,7 @@ def _native_policy_diag(problem) -> None:
         from k8s_spark_scheduler_tpu.native.fifo import (
             native_fifo_available,
             solve_queue_min_frag_native,
+            solve_queue_native,
             solve_queue_single_az_native,
         )
 
@@ -738,6 +739,16 @@ def _native_policy_diag(problem) -> None:
                 file=sys.stderr,
             )
 
+        measure(
+            "native-cpp evenly cpu",
+            lambda: int(
+                solve_queue_native(
+                    problem.avail, problem.driver_rank, problem.exec_ok,
+                    problem.driver, problem.executor, problem.count,
+                    problem.app_valid, evenly=True,
+                )[0].sum()
+            ),
+        )
         measure(
             "native-cpp minfrag cpu",
             lambda: int(
