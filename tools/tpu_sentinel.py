@@ -140,7 +140,10 @@ def _foreign_bench_running() -> bool:
             capture_output=True, text=True, timeout=10,
         )
         return bool(out.stdout.strip())
-    except Exception:
+    except Exception as err:
+        # never fail silently: a swallowed pgrep timeout under load
+        # would let a probe land mid-bench with no trace in the log
+        log(f"foreign-bench check failed ({err}); assuming none")
         return False
 
 
