@@ -45,17 +45,26 @@ def run_detached(argv, timeout_s: float, stdout, stderr) -> Optional[int]:
     return code
 
 
-def probe_default_backend(timeout_s: float = 120.0) -> Optional[str]:
+def probe_default_backend(
+    timeout_s: float = 120.0, nice: bool = False
+) -> Optional[str]:
     """Return the default jax backend name ("tpu", "cpu", ...), or None
     when backend init hangs past ``timeout_s`` or exits nonzero.
 
-    The probe child runs under ``nice -n 19``: its several seconds of
-    jax-init CPU must never perturb latency measurements sharing the
-    single-core dev host (the sentinel also yields to live bench runs,
-    but detection windows exist; niceness bounds the damage)."""
+    nice=True runs the probe child under ``nice -n 19`` — for callers
+    like the TPU sentinel whose repeated probes must never perturb
+    latency measurements sharing the single-core dev host.  It stays
+    OFF by default: a starved probe under CPU contention can time out
+    spuriously, and e.g. the entry() CPU-pinning probe must not
+    mis-diagnose a healthy relay as wedged because a bench was running.
+    """
     argv = [sys.executable, "-c", _PROBE_SRC]
-    if os.path.exists("/usr/bin/nice"):
-        argv = ["/usr/bin/nice", "-n", "19"] + argv
+    if nice:
+        import shutil
+
+        nice_bin = shutil.which("nice")
+        if nice_bin:
+            argv = [nice_bin, "-n", "19"] + argv
     with tempfile.TemporaryFile() as outf, tempfile.TemporaryFile() as errf:
         code = run_detached(argv, timeout_s, outf, errf)
         if code is None:

@@ -176,7 +176,7 @@ def main() -> int:
             continue
         skips = 0
         probe_n += 1
-        backend = probe_default_backend(args.probe_timeout)
+        backend = probe_default_backend(args.probe_timeout, nice=True)
         if backend and "tpu" in backend:
             log(f"probe {probe_n}: relay ALIVE (backend={backend}); running bench")
             ts = datetime.datetime.now(datetime.timezone.utc).strftime(
