@@ -1,0 +1,58 @@
+"""The driver-facing bench contract (VERDICT r3 #1, pinned in CI):
+``python bench.py`` must end its stdout with exactly one parseable
+headline JSON line — even with stderr discarded entirely — and must
+write the durable all-lane artifact to disk.  Smoke shapes must never
+touch the canonical BENCH_RESULT.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_final_line_is_the_headline(tmp_path):
+    env = dict(os.environ)
+    env.update(
+        BENCH_NODES="120", BENCH_APPS="12", BENCH_CHAIN="2",
+        BENCH_ROUNDS="2", BENCH_TPU_BUDGET_S="0", BENCH_E2E_PROBES="2",
+        BENCH_NO_COMMIT="1", JAX_PLATFORMS="cpu",
+        BENCH_JAX_CACHE=str(tmp_path / "cache"),
+    )
+    smoke = os.path.join(REPO, "BENCH_RESULT_smoke.json")
+    if os.path.exists(smoke):
+        os.unlink(smoke)
+    canonical_mtime = (
+        os.path.getmtime(os.path.join(REPO, "BENCH_RESULT.json"))
+        if os.path.exists(os.path.join(REPO, "BENCH_RESULT.json"))
+        else None
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=540,
+        stdin=subprocess.DEVNULL,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert lines, "bench printed nothing to stdout"
+    headline = json.loads(lines[-1])  # the FINAL line is the headline
+    assert headline["metric"].startswith("p99_filter_latency")
+    assert headline["unit"] == "ms"
+    assert headline["value"] > 0
+    assert headline["vs_baseline"] > 0
+    assert headline["backend"] in ("native-cpp", "xla-scan", "pallas")
+
+    # durable artifact on disk, at the SMOKE path for a smoke shape
+    with open(smoke) as f:
+        artifact = json.load(f)
+    assert artifact["headline"] == headline
+    assert artifact["lanes"], "no lanes recorded"
+    assert "fingerprint" in artifact["host"]
+    assert artifact["shape"] == {"nodes": 120, "apps": 12, "chain": 2, "rounds": 2}
+    # the canonical artifact was not touched by the smoke run
+    if canonical_mtime is not None:
+        assert (
+            os.path.getmtime(os.path.join(REPO, "BENCH_RESULT.json"))
+            == canonical_mtime
+        )
