@@ -40,7 +40,8 @@ def test_bench_final_line_is_the_headline(tmp_path):
     assert headline["metric"].startswith("p99_filter_latency")
     assert headline["unit"] == "ms"
     assert headline["value"] > 0
-    assert headline["vs_baseline"] > 0
+    # vs_baseline is the ratio to the 50ms north-star target
+    assert abs(headline["vs_baseline"] - round(50.0 / max(headline["value"], 1e-3), 3)) < 1e-6
     assert headline["backend"] in ("native-cpp", "xla-scan", "pallas")
 
     # durable artifact on disk, at the SMOKE path for a smoke shape
