@@ -40,8 +40,11 @@ def test_bench_final_line_is_the_headline(tmp_path):
     assert headline["metric"].startswith("p99_filter_latency")
     assert headline["unit"] == "ms"
     assert headline["value"] > 0
-    # vs_baseline is the ratio to the 50ms north-star target
-    assert abs(headline["vs_baseline"] - round(50.0 / max(headline["value"], 1e-3), 3)) < 1e-6
+    # vs_baseline is the ratio to the 50ms north-star target (computed
+    # from the unrounded p99, so compare with a relative tolerance that
+    # absorbs the 3-decimal rounding of `value` at smoke-shape latencies)
+    expected = 50.0 / max(headline["value"], 1e-3)
+    assert abs(headline["vs_baseline"] - expected) / expected < 0.05
     assert headline["backend"] in ("native-cpp", "xla-scan", "pallas")
 
     # durable artifact on disk, at the SMOKE path for a smoke shape
