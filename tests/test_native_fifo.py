@@ -95,6 +95,34 @@ def test_overbooked_zero_requirement_dimension():
     np.testing.assert_array_equal(cnts, np.asarray(ref.exec_counts))
 
 
+def test_int32_extremes_in_capacity_pass():
+    """The r5 dim-at-a-time pass corrects a reciprocal-multiply quotient
+    with integer multiply-compares; a[i] = INT32_MAX with divisor 1 must
+    not overflow the correction (the +1 is widened to int64 first) and
+    the full-int32-domain parity with the device scan must hold."""
+    big = np.int32(2**31 - 1)
+    avail = np.array(
+        [[big, big, big], [big, 1, big], [-(2**31), big, 5]], np.int32
+    )
+    rank = np.array([0, 1, 2], np.int32)
+    exec_ok = np.array([True, True, True])
+    drivers = np.array([[1, 1, 0]], np.int32)
+    executors = np.array([[1, 1, 1]], np.int32)  # divisor 1 on a = INT32_MAX
+    counts = np.array([7], np.int32)
+    valid = np.array([True])
+    out = solve_queue(
+        jnp.asarray(avail), jnp.asarray(rank), jnp.asarray(exec_ok),
+        jnp.asarray(drivers), jnp.asarray(executors), jnp.asarray(counts),
+        jnp.asarray(valid), evenly=False, with_placements=False,
+    )
+    feas, didx, avail_after = solve_queue_native(
+        avail, rank, exec_ok, drivers, executors, counts, valid, evenly=False
+    )
+    np.testing.assert_array_equal(feas, np.asarray(out.feasible))
+    np.testing.assert_array_equal(didx, np.asarray(out.driver_idx))
+    np.testing.assert_array_equal(avail_after, np.asarray(out.avail_after))
+
+
 @pytest.mark.parametrize("policy", ["tightly-pack", "distribute-evenly"])
 def test_fifo_solver_native_backend_matches_xla(policy):
     """TpuFifoSolver(backend='native') end-to-end equality with the XLA
