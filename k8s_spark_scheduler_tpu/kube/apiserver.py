@@ -48,6 +48,11 @@ class APIServer:
         self._rv = 0
         # kind → {(namespace, name) → object}
         self._objects: Dict[str, Dict[Tuple[str, str], APIObject]] = defaultdict(dict)
+        # uid → live-object count, maintained by create/delete: the
+        # dangling-owner check used to rebuild a set over EVERY stored
+        # object per create (O(cluster) on the async write-back threads —
+        # ~4ms of stolen GIL per reservation at 10k nodes)
+        self._uid_counts: Dict[str, int] = {}
         self._watchers: Dict[str, List[WatchHandler]] = defaultdict(list)
         self._terminating_namespaces: set[str] = set()
         # registered CRD kinds → established flag
@@ -116,6 +121,10 @@ class APIServer:
             self._rv += 1
             stored.meta.resource_version = self._rv
             self._objects[kind][key] = stored
+            if stored.meta.uid:
+                self._uid_counts[stored.meta.uid] = (
+                    self._uid_counts.get(stored.meta.uid, 0) + 1
+                )
             out = stored.deepcopy()
             dangling = self._has_dangling_owner(stored)
         self._notify(kind, ADDED, stored)
@@ -133,10 +142,10 @@ class APIServer:
     def _has_dangling_owner(self, obj: APIObject) -> bool:
         if not obj.meta.owner_references:
             return False
-        live_uids = {
-            o.meta.uid for objs in self._objects.values() for o in objs.values()
-        }
-        return any(ref.uid and ref.uid not in live_uids for ref in obj.meta.owner_references)
+        return any(
+            ref.uid and ref.uid not in self._uid_counts
+            for ref in obj.meta.owner_references
+        )
 
     def update(self, obj: APIObject) -> APIObject:
         with self._lock:
@@ -166,6 +175,12 @@ class APIServer:
             current = self._objects[kind].pop(key, None)
             if current is None:
                 raise NotFoundError(f"{kind} {key} not found")
+            if current.meta.uid:
+                n = self._uid_counts.get(current.meta.uid, 0) - 1
+                if n > 0:
+                    self._uid_counts[current.meta.uid] = n
+                else:
+                    self._uid_counts.pop(current.meta.uid, None)
             # deletes advance the revision too (as in etcd) so the DELETED
             # event is strictly newer than any prior MODIFIED for this key
             self._rv += 1

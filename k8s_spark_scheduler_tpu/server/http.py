@@ -101,6 +101,10 @@ class _Handler(BaseHTTPRequestHandler):
             ready = self.webhook_only or (
                 self.scheduler is not None
                 and self.scheduler.informer_factory.wait_for_cache_sync()
+                # solver warmup still compiling: admitting traffic now
+                # would put jit latency (and compiler-thread CPU
+                # contention) on the first Filter requests
+                and self.scheduler.warmup_complete()
             )
             self._send_json(200 if ready else 503, {"ready": ready})
         elif self.path == "/metrics" and self.scheduler is not None:

@@ -71,6 +71,29 @@ class Server:
             self.reporters.start()
         self._warm_solver_async()
 
+    def warmup_complete(self) -> bool:
+        """True once the background solver warmup has finished (or never
+        started).  Readiness gates on this: traffic admitted before the
+        kernels are compiled pays jit latency on the request path, and —
+        worse on a small host — the warmup's compiler threads compete
+        with live Filter requests for cores."""
+        ev = getattr(self, "_warm_done", None)
+        return ev is None or ev.is_set()
+
+    def wait_ready(self, timeout: float = 120.0) -> bool:
+        """Block until caches are synced AND the solver warmup finished
+        (the readiness condition) — what a deployment's readiness probe
+        polls for before kube-scheduler sends the first Filter."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        if not self.informer_factory.wait_for_cache_sync():
+            return False
+        ev = getattr(self, "_warm_done", None)
+        if ev is not None and not ev.wait(max(0.0, deadline - _time.monotonic())):
+            return False
+        return True
+
     def _warm_solver_async(self) -> None:
         """Pre-compile the device solver kernels for the common shape
         buckets in the background so the first Filter request doesn't
@@ -81,7 +104,11 @@ class Server:
         ("FATAL: exception not rethrown" from pthread teardown inside
         the compiler).  It stays a daemon thread so a compile wedged on
         a dead device can never block process exit outright."""
+        import threading
+
+        self._warm_done = threading.Event()
         if not self.extender.binpacker.name.startswith("tpu-batch"):
+            self._warm_done.set()
             return
 
         def warm():
@@ -113,6 +140,31 @@ class Server:
                 single_az = "single-az" in name or name.endswith("az-aware")
                 saz_minfrag = name == "tpu-batch-single-az-minimal-fragmentation"
                 use_pallas = _pallas_selected("auto")
+
+                # on accelerator-less hosts the native C++ lane serves the
+                # queue pass, so compiling the device kernels here would
+                # burn the serving core for minutes (the compiler threads
+                # ran concurrently with live Filters before this guard)
+                # for code the deployment never dispatches.  Build/load
+                # the native library instead; the plain policies then
+                # need no XLA at all (fallbacks compile on demand), and
+                # the single-AZ policies keep only the kernels their
+                # host-math path actually calls (solve_single +
+                # solve_zones_jit for the current-app pack).
+                native_lane = False
+                if not use_pallas:
+                    try:
+                        from ..ops.fifo_solver import _native_selected
+
+                        solver_backend = getattr(
+                            self.extender.binpacker.queue_solver,
+                            "backend", "auto",
+                        )
+                        native_lane = _native_selected(solver_backend)
+                    except Exception:
+                        native_lane = False
+                if native_lane and not single_az:
+                    return
                 warm_zones = 3  # zone count is a compile shape; 3 AZs is typical
                 for nb in NODE_BUCKETS[:3]:  # the shapes real clusters hit first
                     if self._warm_stop.is_set():
@@ -137,6 +189,10 @@ class Server:
                             jnp.zeros((warm_zones, nb), bool),
                             row, row, jnp.int32(0),
                         )
+                    if native_lane:
+                        # single-AZ native: the C++ lane runs the queue
+                        # scan; only the host-math kernels above are hit
+                        continue
                     if single_az and saz_minfrag:
                         # the fused min-frag single-AZ scan (XLA only);
                         # strict is a static jit argname, so warm the
@@ -213,8 +269,8 @@ class Server:
                     "solver warmup failed; first request will compile",
                     exc_info=True,
                 )
-
-        import threading
+            finally:
+                self._warm_done.set()
 
         self._warm_stop = threading.Event()
         self._warm_thread = threading.Thread(

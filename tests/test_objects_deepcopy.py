@@ -1,0 +1,129 @@
+"""The hand-rolled fast deepcopy overrides (types/objects.py) must be
+observably identical to copy.deepcopy: equal trees, and full mutation
+isolation for every mutable field the framework actually mutates
+(reservation nodes/status pods, pod phase/conditions/labels, node
+flags, demand status)."""
+
+import copy
+
+from k8s_spark_scheduler_tpu.types.objects import (
+    Container,
+    Demand,
+    DemandSpec,
+    DemandStatus,
+    DemandUnit,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodCondition,
+    Reservation,
+    ResourceReservation,
+    ResourceReservationSpec,
+    ResourceReservationStatus,
+)
+from k8s_spark_scheduler_tpu.types.resources import Resources
+
+
+def _meta():
+    return ObjectMeta(
+        name="a",
+        namespace="ns",
+        labels={"x": "1"},
+        annotations={"y": "2"},
+        creation_timestamp=123.0,
+        resource_version=7,
+        uid="uid-1",
+        owner_references=[OwnerReference("Pod", "p", "uid-0")],
+    )
+
+
+def _pod():
+    return Pod(
+        meta=_meta(),
+        scheduler_name="sched",
+        node_name="",
+        node_selector={"a": "b"},
+        node_affinity={"ig": ["g1", "g2"]},
+        affinity_terms=[[("k", "In", ["v1"])], [("k2", "Exists", [])]],
+        containers=[Container("main", Resources.of("1", "2Gi"))],
+        init_containers=[Container("init", Resources.of("1", "1Gi"))],
+        phase="Pending",
+        container_terminated=[False],
+        conditions={"PodScheduled": PodCondition("PodScheduled", "False")},
+    )
+
+
+def _rr():
+    return ResourceReservation(
+        meta=_meta(),
+        spec=ResourceReservationSpec(
+            reservations={
+                "driver": Reservation.for_resources("n1", Resources.of("1", "2Gi")),
+                "executor-1": Reservation.for_resources("n2", Resources.of("2", "4Gi")),
+            }
+        ),
+        status=ResourceReservationStatus(pods={"driver": "p-driver"}),
+    )
+
+
+def _demand():
+    return Demand(
+        meta=_meta(),
+        spec=DemandSpec(
+            units=[
+                DemandUnit(
+                    Resources.of("1", "2Gi"), 3, {"ns": ["p1", "p2"]}
+                )
+            ],
+            instance_group="ig",
+            zone="z1",
+        ),
+        status=DemandStatus(phase="pending", last_transition_time=9.0),
+    )
+
+
+def _node():
+    return Node(meta=_meta(), allocatable=Resources.of("8", "16Gi"), ready=True)
+
+
+def test_fast_deepcopy_equals_generic():
+    for obj in (_pod(), _rr(), _demand(), _node()):
+        fast = obj.deepcopy()
+        generic = copy.deepcopy(obj)
+        assert fast == generic, type(obj).__name__
+
+
+def test_mutation_isolation():
+    rr = _rr()
+    c = rr.deepcopy()
+    c.spec.reservations["executor-1"].node = "other"
+    c.status.pods["executor-1"] = "p-exec"
+    c.meta.labels["mut"] = "1"
+    c.meta.owner_references.append(OwnerReference("Pod", "q", "uid-9"))
+    c.spec.reservations["driver"].resources["cpu"] = None
+    assert rr.spec.reservations["executor-1"].node == "n2"
+    assert "executor-1" not in rr.status.pods
+    assert "mut" not in rr.meta.labels
+    assert len(rr.meta.owner_references) == 1
+    assert rr.spec.reservations["driver"].resources["cpu"] is not None
+
+    pod = _pod()
+    pc = pod.deepcopy()
+    pc.conditions["PodScheduled"].status = "True"
+    pc.node_selector["a"] = "z"
+    pc.node_affinity["ig"].append("g3")
+    pc.container_terminated[0] = True
+    pc.affinity_terms[0].append(("k3", "In", ["v"]))
+    assert pod.conditions["PodScheduled"].status == "False"
+    assert pod.node_selector["a"] == "b"
+    assert pod.node_affinity["ig"] == ["g1", "g2"]
+    assert pod.container_terminated == [False]
+    assert len(pod.affinity_terms[0]) == 1
+
+    d = _demand()
+    dc = d.deepcopy()
+    dc.status.phase = "fulfilled"
+    dc.spec.units[0].pod_names_by_namespace["ns"].append("p3")
+    assert d.status.phase == "pending"
+    assert d.spec.units[0].pod_names_by_namespace["ns"] == ["p1", "p2"]

@@ -106,6 +106,10 @@ def build(n_nodes: int, n_apps: int, probes: int):
         probe_pods.append(api.create(d))
     http = ExtenderHTTPServer(scheduler, port=0)
     http.start()
+    # the readiness condition a real deployment gates traffic on: caches
+    # synced AND solver warmup finished (warmup compiler threads would
+    # otherwise contend with the timed probes on a small host)
+    scheduler.wait_ready(timeout=600.0)
     return api, scheduler, http, names, probe_pods
 
 
