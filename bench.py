@@ -1,12 +1,18 @@
-"""Benchmark: Filter-equivalent latency on the BASELINE north-star
-snapshot — 10k nodes × 1k pending apps, whole-FIFO-queue gang solve
-(the Pallas VMEM-resident queue kernel).
+"""Benchmark: HTTP Filter latency on the BASELINE north-star snapshot —
+10k nodes × 1k pending apps through the REAL extender server.
 
-The measured operation is what a Filter request costs at steady state
-with a 1k-deep driver queue: one whole-queue batched repack (FIFO
-earlier-drivers pass + the current driver's gang decision).  Snapshot
-tensors are maintained incrementally by the control plane, so
-marshalling is off the hot path (reported separately).
+The HEADLINE is request-level (VERDICT r4 #2): the p99 of POST
+/predicates round trips measured at the HTTP boundary (config5-e2e —
+server/http.py → serde → Predicate → tensor mirror → queue lane →
+reservation create), at steady state: every timed probe driver is
+deleted (with its reservation) after its sample, so all ≥200 samples
+measure the same 10k×1k problem with probe apps drawn from the same
+1-32-executor distribution as the queue.  The solver-only lanes
+(pallas / native C++ / XLA scan chained queue solves) are recorded as
+diagnostics in the same artifact; when the e2e phase cannot run, the
+headline falls back to the solver lane under the honest name
+``p99_queue_solve_…`` so a solver microbench can never masquerade as
+the Filter SLO.
 
 Measurement method: this dev environment reaches the TPU through a
 network relay whose round-trip (~67 ms) dwarfs device time and does not
@@ -271,7 +277,11 @@ def _emit(
 
     p99 = float(np.percentile(lat, 99))
     result = {
-        "metric": "p99_filter_latency_10k_nodes_x_1k_apps_batched_repack",
+        # solver-lane metric: a chained whole-queue solve on prebuilt
+        # tensors.  Deliberately NOT named "filter latency" — the Filter
+        # is the HTTP request, measured by config5-e2e (VERDICT r4 #2);
+        # main() promotes that request-level number to the headline.
+        "metric": "p99_queue_solve_10k_nodes_x_1k_apps_batched_repack",
         "value": round(p99, 3),
         "unit": "ms",
         # the floor only guards the division (tiny smoke shapes can
@@ -373,6 +383,18 @@ def tpu_worker() -> int:
     sys.stdout.flush()
     _single_az_diag(problem, rtt_s)
     _min_frag_diag(problem, rtt_s)
+    # request-level lane on the device backend (VERDICT r4 #4): the HTTP
+    # Filter driven by the pallas queue lane.  Runs LAST — the solver
+    # evidence above is already on stdout, so a relay wedge here cannot
+    # cost it.  Per-request latency through the dev relay includes the
+    # ~67ms tunnel RTT a co-located deployment doesn't pay; the lane
+    # records rtt_ms context for exactly that.
+    os.environ.setdefault("BENCH_E2E_PROBES", "25")
+    e2e = _config5_e2e(force_cpu=False)
+    if e2e is not None:
+        e2e["relay_rtt_ms"] = round(rtt_s * 1000.0, 1)
+        print(_LANES_PREFIX + json.dumps({"config5-e2e http (tpu)": e2e}))
+        sys.stdout.flush()
     return 0
 
 
@@ -826,20 +848,66 @@ def _native_cpu_measure(problem):
         return None
 
 
+def _check_load() -> bool:
+    """VERDICT r4 #8: annotate the artifact loudly when another heavy
+    process owns the core at run start, so cross-round deltas mean
+    something.  Threshold: on this nproc-core host a 1-minute load
+    above 0.5·nproc means the bench shares its core(s)."""
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        return True
+    ok = load1 <= 0.5 * (os.cpu_count() or 1)
+    if not ok:
+        print(
+            f"# WARNING: loadavg_1m={load1:.2f} at bench start — another "
+            "process is using the core; latencies are NOT comparable "
+            "across rounds (artifact carries load_ok=false)",
+            file=sys.stderr,
+        )
+    return ok
+
+
 def main() -> None:
     budget_s = float(os.environ.get("BENCH_TPU_BUDGET_S", "600"))
     attempt_s = float(os.environ.get("BENCH_TPU_ATTEMPT_S", "240"))
+    load_ok = _check_load()
 
-    headline = try_tpu(budget_s, attempt_s) if budget_s > 0 else None
-    if headline is None:
+    solver = try_tpu(budget_s, attempt_s) if budget_s > 0 else None
+    if solver is None:
         print("# TPU backend unavailable; benching on CPU", file=sys.stderr)
-        headline = cpu_fallback()
+        solver = cpu_fallback()
+    solver["load_ok"] = load_ok
     # write the durable artifact BEFORE the secondary configs: a kill
     # during those (they are unbounded harness runs) must not cost the
-    # headline evidence; rewritten afterwards with SECONDARY filled in
-    _write_bench_result(headline, commit=False)
+    # solver-lane evidence; rewritten afterwards with SECONDARY + the
+    # request-level headline filled in
+    _write_bench_result(solver, commit=False)
     _secondary_configs()
-    _config5_e2e()
+    e2e = _config5_e2e()
+    if e2e is not None:
+        # the headline is the request-level number measured at the HTTP
+        # boundary (VERDICT r4 #2); the solver lane rides along so the
+        # two can never be confused
+        p99 = e2e["p99_ms"]
+        headline = {
+            "metric": "p99_filter_latency_10k_nodes_x_1k_apps_batched_repack",
+            "value": round(p99, 3),
+            "unit": "ms",
+            "vs_baseline": round(TARGET_MS / max(p99, 1e-3), 3),
+            "backend": e2e["backend"],
+            "samples": e2e["rounds"],
+            "p50_ms": e2e["p50_ms"],
+            "p95_ms": e2e.get("p95_ms"),
+            "measured_at": "http",
+            "solver_p99_ms": solver.get("value"),
+            "solver_backend": solver.get("backend"),
+            "load_ok": load_ok,
+        }
+    else:
+        # no request-level measurement: the solver lane stands, under
+        # its own honest p99_queue_solve_… name
+        headline = solver
     _write_bench_result(headline)
     # the headline is the FINAL stdout line, emitted after everything
     # that could possibly crash or spew — a tail-window capture (the
@@ -875,6 +943,17 @@ def _write_bench_result(headline: dict, commit: bool = True) -> None:
     # only canonical-shape runs are evidence worth a commit
     if not commit or not canonical or os.environ.get("BENCH_NO_COMMIT"):
         return
+    # a rebase/merge in flight means a HUMAN owns the index right now —
+    # an automatic evidence commit would land mid-operation (ADVICE r4
+    # #2); the artifact stays on disk for them to commit
+    for marker in ("MERGE_HEAD", "rebase-merge", "rebase-apply", "CHERRY_PICK_HEAD"):
+        if os.path.exists(os.path.join(repo, ".git", marker)):
+            print(
+                f"# skipping evidence commit: .git/{marker} present "
+                "(rebase/merge in progress)",
+                file=sys.stderr,
+            )
+            return
     msg = (
         f"bench evidence: {headline.get('backend')} p99 {headline.get('value')}ms"
     )
@@ -991,22 +1070,30 @@ def _secondary_configs() -> None:
         logging.disable(logging.NOTSET)
 
 
-def _config5_e2e() -> None:
-    """(5) end-to-end: the north-star snapshot through the REAL HTTP
-    extender — N_NODES nodes, N_APPS pending FIFO drivers, and the
-    youngest driver's Filter measured at the request level
+def _config5_e2e(force_cpu: bool = True) -> dict | None:
+    """(5) end-to-end, the HEADLINE phase: the north-star snapshot
+    through the REAL HTTP extender — N_NODES nodes, N_APPS pending FIFO
+    drivers, Filter latency measured at the request level
     (server/http.py → serde → Predicate → tensor mirror → native/device
-    queue lane).  Proves the solver-only headline survives the full
-    request path (VERDICT r3 #5; reference path resource.go:128-183 +
-    cmd/endpoints.go:29-41)."""
+    queue lane; reference path resource.go:128-183 +
+    cmd/endpoints.go:29-41).
+
+    Sampling (VERDICT r4 #3): ≥200 timed probes drawn from the SAME
+    1-32-executor / 1-8-cpu / 2-16Gi distribution as the queue.  After
+    each sample the probe pod is deleted and its reservation collected
+    (the app-finished flow), and the next probe waits for that settling
+    — so every sample measures the identical steady-state 10k×1k
+    problem instead of a growing queue.  Returns the lane stats dict
+    (with `backend` = the queue lane that actually served) or None."""
     import json as _json
     import logging
     import urllib.request
 
-    import jax
+    if force_cpu:
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    probes = int(os.environ.get("BENCH_E2E_PROBES", "25"))
+        jax.config.update("jax_platforms", "cpu")
+    probes = int(os.environ.get("BENCH_E2E_PROBES", "200"))
     http = scheduler = None
     try:
         from k8s_spark_scheduler_tpu.config import Install
@@ -1060,14 +1147,12 @@ def _config5_e2e() -> None:
                 creation_timestamp=base + i,
             )[0]
             api.create(d)
-        probe_pods = []
-        for i in range(probes):
-            d = Harness.static_allocation_spark_pods(
-                f"probe-{i:03d}", 4, creation_timestamp=base + N_APPS + i
-            )[0]
-            probe_pods.append(api.create(d))
         http = ExtenderHTTPServer(scheduler, port=0)
         http.start()
+        # the readiness condition a deployment gates traffic on: caches
+        # synced AND solver warmup done (its compiler threads would
+        # otherwise contend with the timed probes for the core)
+        scheduler.wait_ready(timeout=600.0)
         setup_s = time.perf_counter() - t_setup
 
         def post_filter(pod):
@@ -1086,34 +1171,78 @@ def _config5_e2e() -> None:
                 body = _json.loads(resp.read())
             return (time.perf_counter() - t0) * 1000.0, body
 
-        # warmup (compile/mirror build) then one timed request per probe
-        # driver — each leaves a reservation, so the ~N_APPS-deep pending
-        # queue is re-solved per request exactly like production Filters
-        warm_ms, _ = post_filter(probe_pods[0])
+        rr_cache = scheduler.resource_reservation_cache
+
+        def retire_probe(pod, app_id):
+            """The app-finished flow: delete the probe pod (owner GC
+            collects its reservation — or the dangling-owner check does,
+            if the async create lands later) and wait until the
+            reservation cache has dropped the app, so the next sample
+            sees the exact steady-state shape again."""
+            api.delete("Pod", pod.namespace, pod.name)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if rr_cache.get(pod.namespace, app_id) is None:
+                    return True
+                time.sleep(0.002)
+            return False
+
+        def one_probe(i):
+            d = Harness.static_allocation_spark_pods(
+                f"probe-{i:04d}",
+                int(rng.randint(1, 32)),
+                executor_cpu=str(int(rng.randint(1, 8))),
+                executor_mem=f"{int(rng.randint(2, 16))}Gi",
+                creation_timestamp=base + N_APPS + i,
+            )[0]
+            pod = api.create(d)
+            ms, body = post_filter(pod)
+            ok = bool(body.get("NodeNames") or body.get("nodeNames"))
+            settled = retire_probe(pod, pod.labels.get("spark-app-id", ""))
+            return ms, ok, settled
+
+        # warmups absorb compile / tensor-mirror build / cache priming
+        warm_ms, _, _ = one_probe(0)
+        one_probe(1)
         lat_ms = []
         granted = 0
-        for pod in probe_pods[1:]:
-            ms, body = post_filter(pod)
+        unsettled = 0
+        for i in range(2, probes + 2):
+            ms, ok, settled = one_probe(i)
             lat_ms.append(ms)
-            granted += bool(body.get("NodeNames") or body.get("nodeNames"))
+            granted += ok
+            unsettled += not settled
         lat = np.array(lat_ms)
         p99 = float(np.percentile(lat, 99))
         stats = _lane_stats(lat, granted)
+        stats["p95_ms"] = round(float(np.percentile(lat, 95)), 3)
         stats["setup_s"] = round(setup_s, 1)
         stats["warmup_ms"] = round(warm_ms, 1)
+        stats["unsettled"] = unsettled
+        solver = getattr(scheduler.extender.binpacker, "queue_solver", None)
+        lane = getattr(solver, "last_queue_lane", None)
+        stats["backend"] = {
+            "native": "native-cpp", "native-minfrag": "native-cpp",
+            "pallas": "pallas", "pallas-minfrag": "pallas",
+            "xla": "xla-scan", "minfrag-xla": "xla-scan",
+        }.get(lane, lane or "unknown")
         LANES["config5-e2e http"] = stats
         SECONDARY["config5_e2e_p99_ms"] = round(p99, 1)
         SECONDARY["config5_e2e_p50_ms"] = round(float(np.percentile(lat, 50)), 1)
         SECONDARY["config5_e2e_granted"] = granted
         print(
             f"# config5-e2e HTTP Filter {N_NODES}x{N_APPS}: "
-            f"p99={p99:.1f}ms p50={np.percentile(lat, 50):.1f}ms "
-            f"granted={granted}/{len(lat_ms)} warmup={warm_ms:.0f}ms "
+            f"p99={p99:.1f}ms p95={stats['p95_ms']:.1f}ms "
+            f"p50={np.percentile(lat, 50):.1f}ms n={len(lat_ms)} "
+            f"granted={granted}/{len(lat_ms)} lane={stats['backend']} "
+            f"unsettled={unsettled} warmup={warm_ms:.0f}ms "
             f"setup={setup_s:.0f}s",
             file=sys.stderr,
         )
+        return stats
     except Exception as err:
         print(f"# config5-e2e failed: {err}", file=sys.stderr)
+        return None
     finally:
         try:
             if http is not None:

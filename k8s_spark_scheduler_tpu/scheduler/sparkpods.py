@@ -148,14 +148,19 @@ def _cached_entry(pod: Pod):
     if hit is None:
         try:
             sar = spark_resources(pod)
-            hit = (
-                sar,
-                AppDemand(
-                    sar.driver_resources,
-                    sar.executor_resources,
-                    sar.min_executor_count,
-                ),
+            demand = AppDemand(
+                sar.driver_resources,
+                sar.executor_resources,
+                sar.min_executor_count,
             )
+            # precompute the exact tensor rows BEFORE the instance is
+            # shared: request threads then only read the stash, so the
+            # tensorize-layer lazy fallback never writes to a shared
+            # AppDemand from concurrent requests (ADVICE r4 #3)
+            from ..ops.tensorize import _app_base_rows
+
+            _app_base_rows(demand)
+            hit = (sar, demand)
         except AnnotationError as err:
             hit = err
         if key is not None:

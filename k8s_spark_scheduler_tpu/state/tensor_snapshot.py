@@ -108,6 +108,8 @@ class TensorSnapshotCache:
         # from different maintainers can never alias in consumer caches
         self._instance_id = next(_INSTANCE_SEQ)
         self._structure_rev = 0
+        # snapshot()'s structure-derived parts, keyed by _structure_rev
+        self._struct_cache = None
 
         # node table
         self._node_slot: Dict[str, int] = {}
@@ -398,27 +400,51 @@ class TensorSnapshotCache:
                 self._recompute_overhead()
             if self._names_dirty:
                 self._recompute_name_ranks()
-            live = [i for i, name in enumerate(self._node_names) if name is not None]
-            idx = np.array(live, dtype=np.int64)
-            if len(idx) == 0:
-                idx = np.zeros(0, dtype=np.int64)
+            # structure-derived parts (the Python-loop costs: live-slot
+            # scan + 10k-element name/label lists) are cached per
+            # structure revision — every mutation of names, labels,
+            # zones, ready or unschedulable bumps _structure_rev
+            # (_on_node/_on_node_delete), so a cache hit can only serve
+            # identical structure.  The cached numpy rows are .copy()s,
+            # never views, so later in-place maintainer writes (which
+            # bump the rev) cannot reach snapshots already handed out.
+            sc = self._struct_cache
+            if sc is None or sc[0] != self._structure_rev:
+                live = [
+                    i for i, name in enumerate(self._node_names) if name is not None
+                ]
+                idx = np.array(live, dtype=np.int64)
+                if len(idx) == 0:
+                    idx = np.zeros(0, dtype=np.int64)
+                sc = (
+                    self._structure_rev,
+                    idx,
+                    [self._node_names[i] for i in live],
+                    # label dicts are replaced (never mutated) on node
+                    # events, so sharing the references is safe
+                    [self._labels[i] for i in live],
+                    list(self._zone_names),
+                    self._zone_id[idx].copy(),
+                    self._ready[idx].copy(),
+                    self._unsched[idx].copy(),
+                    self._name_rank[idx].copy(),
+                )
+                self._struct_cache = sc
+            _, idx, names, labels, zone_names, zone_id, ready, unsched, ranks = sc
             return TensorSnapshot(
-                names=[self._node_names[i] for i in live],
+                names=names,
                 allocatable=self._alloc[idx].copy(),
                 usage=self._usage[idx].copy(),
                 overhead=self._node_overhead[idx].copy()
                 if len(self._node_overhead) >= len(self._node_names)
-                else np.zeros((len(live), 3), np.int64),
-                zone_names=list(self._zone_names),
-                zone_id=self._zone_id[idx].copy(),
-                ready=self._ready[idx].copy(),
-                unschedulable=self._unsched[idx].copy(),
-                # label dicts are replaced (never mutated) on node events,
-                # so sharing the references is safe and skips 10k dict
-                # copies per request
-                labels=[self._labels[i] for i in live],
+                else np.zeros((len(names), 3), np.int64),
+                zone_names=zone_names,
+                zone_id=zone_id,
+                ready=ready,
+                unschedulable=unsched,
+                labels=labels,
                 exact=self._exact,
                 res_entries=self._res_count[idx] > 0,  # comparison allocates fresh
-                name_rank=self._name_rank[idx].copy(),
+                name_rank=ranks,
                 structure_key=(self._instance_id, self._structure_rev),
             )

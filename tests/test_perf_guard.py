@@ -7,9 +7,14 @@ round artifact.  Analog of the reference's verify gate
 (.circleci/config.yml:341-368).
 
 Measured context: at 10k nodes x 1k apps the native lane is ~8x faster
-than the XLA scan (35ms vs 286ms); the 4x bound leaves a 2x margin.
+than the XLA scan (35ms vs 286ms; ~15x after the r5 dim-at-a-time
+pass); the 4x bound leaves margin.  The bound is host-shape dependent —
+the XLA CPU scan can parallelize across cores while the native lane is
+single-threaded — so a many-core CI host can override it via
+PERF_GUARD_MIN_SPEEDUP (ADVICE r4 #1).
 """
 
+import os
 import time
 
 import numpy as np
@@ -29,7 +34,7 @@ pytestmark = pytest.mark.skipif(
 
 N_NODES = 2000
 N_APPS = 200
-MIN_SPEEDUP = 4.0
+MIN_SPEEDUP = float(os.environ.get("PERF_GUARD_MIN_SPEEDUP", "4.0"))
 
 
 def _problem():

@@ -53,7 +53,20 @@ def main() -> int:
                     help="standing pending drivers (never bound): every "
                     "Filter runs a real earlier-drivers queue pass, so "
                     "the per-pod-version parse cache is exercised")
+    ap.add_argument("--no-tracemalloc", action="store_true",
+                    help="skip allocation tracking (it slows requests "
+                    "~30%%; latency numbers come from bench.py, the "
+                    "soak's job is leaks + failures)")
     args = ap.parse_args()
+
+    tm_snap_start = None
+    if not args.no_tracemalloc:
+        # VERDICT r4 #7: RSS growth must be attributable, not just
+        # bounded — snapshot allocations at steady-state start and end,
+        # diff by line, report the top growers
+        import tracemalloc
+
+        tracemalloc.start(12)
 
     import logging
 
@@ -93,17 +106,26 @@ def main() -> int:
                         "resource_channel": "batch-medium-priority",
                     },
                 ),
-                allocatable=Resources.of("16", "32Gi"),
+                # heterogeneous pool like the north-star snapshot (the
+                # BASELINE config-5 node distribution)
+                allocatable=Resources.of(
+                    str(int(rng.randint(4, 96))), f"{int(rng.randint(8, 256))}Gi"
+                ),
             )
         )
 
     # standing backlog: old (enforced) but FEASIBLE pending drivers that
-    # are never bound — each cycle's Filters repack them first
+    # are never bound — each cycle's Filters repack them first; sizes
+    # drawn from the north-star queue's 1-32-executor distribution
     backlog_base = time.time() - 10_000.0
     for i in range(args.backlog):
         api.create(
             Harness.static_allocation_spark_pods(
-                f"backlog-{i:03d}", 1, creation_timestamp=backlog_base + i
+                f"backlog-{i:04d}",
+                int(rng.randint(1, 32)),
+                executor_cpu=str(int(rng.randint(1, 8))),
+                executor_mem=f"{int(rng.randint(2, 16))}Gi",
+                creation_timestamp=backlog_base + i,
             )[0]
         )
 
@@ -174,6 +196,11 @@ def main() -> int:
             t_report = time.time()
             rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
             rss_marks.append(rss)
+            if not args.no_tracemalloc and tm_snap_start is None:
+                # first report = warmup/compile done; steady state begins
+                import tracemalloc
+
+                tm_snap_start = tracemalloc.take_snapshot()
             lat = np.array(lat_ms[-2000:])
             print(
                 f"# {cycle} cycles, p50={np.percentile(lat, 50):.1f}ms "
@@ -197,6 +224,16 @@ def main() -> int:
     rss_growth_mb = (
         (rss_marks[-1] - rss_marks[1]) // 1024 if len(rss_marks) > 2 else 0
     )
+    growth_top = []
+    if tm_snap_start is not None:
+        import tracemalloc
+
+        diff = tracemalloc.take_snapshot().compare_to(tm_snap_start, "lineno")
+        growth_top = [
+            f"{stat.traceback} +{stat.size_diff / 1024:.0f}KB "
+            f"(count {stat.count_diff:+d})"
+            for stat in diff[:3]
+        ]
     ok = (
         failures == 0
         and len(rrs) == 0
@@ -216,6 +253,7 @@ def main() -> int:
         "parse_cache": parse_n,
         "selector_revs": sel_n,
         "steady_rss_growth_mb": rss_growth_mb,
+        "rss_growth_top3": growth_top,
         "ok": bool(ok),
     }))
     http.stop()
