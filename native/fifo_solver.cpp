@@ -213,13 +213,34 @@ int64_t mf_cap_pass_all(const int32_t* a0, const int32_t* a1,
   return total;
 }
 
-MfExtremes mf_extremes(const std::vector<int32_t>& caps, int32_t k) {
+// pure single-accumulator reductions vectorize; the fused 3-accumulator
+// select loop does not (measured 20 us vs 3.6 us at 10k — r5 A/B), so
+// the conditional mins are a select MAP into scratch followed by a pure
+// min REDUCE.
+__attribute__((noinline)) int32_t reduce_max(const int32_t* p, int64_t n) {
+  int32_t m = 0;
+  for (int64_t i = 0; i < n; ++i) m = std::max(m, p[i]);
+  return m;
+}
+
+__attribute__((noinline)) int32_t reduce_min(const int32_t* p, int64_t n) {
+  int32_t m = kBig;
+  for (int64_t i = 0; i < n; ++i) m = std::min(m, p[i]);
+  return m;
+}
+
+MfExtremes mf_extremes(const std::vector<int32_t>& caps, int32_t k,
+                       std::vector<int32_t>& scratch) {
   MfExtremes ext;
-  for (const int32_t c : caps) {
-    ext.maxc = std::max(ext.maxc, c);
-    ext.min_ge = std::min(ext.min_ge, c >= k ? c : kBig);
-    ext.min_pos = std::min(ext.min_pos, c > 0 ? c : kBig);
-  }
+  const int32_t* p = caps.data();
+  const int64_t n = static_cast<int64_t>(caps.size());
+  scratch.resize(n);
+  int32_t* s = scratch.data();
+  ext.maxc = reduce_max(p, n);
+  for (int64_t i = 0; i < n; ++i) s[i] = p[i] >= k ? p[i] : kBig;
+  ext.min_ge = reduce_min(s, n);
+  for (int64_t i = 0; i < n; ++i) s[i] = p[i] > 0 ? p[i] : kBig;
+  ext.min_pos = reduce_min(s, n);
   return ext;
 }
 
@@ -648,7 +669,8 @@ int fifo_solve_queue_minfrag(int64_t nb, int64_t na, int32_t* avail_io,
       mf_caps[didx] = mf_cap_one(av[0], av[1], av[2], e);
     }
     bool placed_any =
-        k > 0 && mf_assign(mf_caps, k, mf_extremes(mf_caps, k), mf_ws, segs);
+        k > 0 && mf_assign(mf_caps, k, mf_extremes(mf_caps, k, mf_ws.copy),
+                           mf_ws, segs);
 
     // usage subtraction quirk: one executor's worth per hosting node,
     // the driver row on its node unless it also hosts executors
@@ -811,7 +833,8 @@ int fifo_solve_queue_single_az(
           mf_caps[dz] = mf_cap_one(av[0], av[1], av[2], e);
         }
         if (k > 0)
-          ok = mf_assign(mf_caps, k, mf_extremes(mf_caps, k), mf_ws, segs);
+          ok = mf_assign(mf_caps, k, mf_extremes(mf_caps, k, mf_ws.copy),
+                         mf_ws, segs);
       } else if (k > 0) {
         // tightly-pack greedy fill in node order within the zone
         int64_t cum = 0;
