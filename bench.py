@@ -138,8 +138,15 @@ def _enable_compile_cache() -> None:
     try:
         import jax
 
+        # axon remote-compile sessions produce CPU AOT code targeted at
+        # the RELAY host's features; keyed separately so a TPU worker
+        # can never poison the local-CPU cache (the r3 cpu_aot_loader
+        # spew came back through exactly this path in r5)
+        suffix = ""
+        if (os.environ.get("JAX_PLATFORMS", "") or "").strip() not in ("", "cpu"):
+            suffix = "-axon"
         cache_dir = os.environ.get("BENCH_JAX_CACHE") or os.path.join(
-            os.path.dirname(__file__), ".jax_cache", _machine_fingerprint()
+            os.path.dirname(__file__), ".jax_cache", _machine_fingerprint() + suffix
         )
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -53,6 +53,10 @@ class APIServer:
         # object per create (O(cluster) on the async write-back threads —
         # ~4ms of stolen GIL per reservation at 10k nodes)
         self._uid_counts: Dict[str, int] = {}
+        # owner uid → {(kind, ns, name)} of dependents: owner-reference
+        # GC used to scan every stored object per delete (O(cluster) —
+        # the app-finished flow deletes pods constantly)
+        self._owner_index: Dict[str, set] = {}
         self._watchers: Dict[str, List[WatchHandler]] = defaultdict(list)
         self._terminating_namespaces: set[str] = set()
         # registered CRD kinds → established flag
@@ -125,6 +129,7 @@ class APIServer:
                 self._uid_counts[stored.meta.uid] = (
                     self._uid_counts.get(stored.meta.uid, 0) + 1
                 )
+            self._index_owners(stored, kind, key, add=True)
             out = stored.deepcopy()
             dangling = self._has_dangling_owner(stored)
         self._notify(kind, ADDED, stored)
@@ -147,6 +152,20 @@ class APIServer:
             for ref in obj.meta.owner_references
         )
 
+    def _index_owners(self, obj: APIObject, kind: str, key, add: bool) -> None:
+        entry = (kind, key[0], key[1])
+        for ref in obj.meta.owner_references:
+            if not ref.uid:
+                continue
+            if add:
+                self._owner_index.setdefault(ref.uid, set()).add(entry)
+            else:
+                deps = self._owner_index.get(ref.uid)
+                if deps is not None:
+                    deps.discard(entry)
+                    if not deps:
+                        del self._owner_index[ref.uid]
+
     def update(self, obj: APIObject) -> APIObject:
         with self._lock:
             kind = obj.KIND
@@ -165,6 +184,9 @@ class APIServer:
             self._rv += 1
             stored.meta.resource_version = self._rv
             self._objects[kind][key] = stored
+            # owner references may change across an update
+            self._index_owners(current, kind, key, add=False)
+            self._index_owners(stored, kind, key, add=True)
             out = stored.deepcopy()
         self._notify(kind, MODIFIED, stored)
         return out
@@ -181,6 +203,7 @@ class APIServer:
                     self._uid_counts[current.meta.uid] = n
                 else:
                     self._uid_counts.pop(current.meta.uid, None)
+            self._index_owners(current, kind, key, add=False)
             # deletes advance the revision too (as in etcd) so the DELETED
             # event is strictly newer than any prior MODIFIED for this key
             self._rv += 1
@@ -223,16 +246,14 @@ class APIServer:
     def _garbage_collect_owned(self, owner: APIObject) -> None:
         """Owner-reference GC: deleting an owner cascades to dependents
         (the reference relies on k8s GC via ownerReferences,
-        resourcereservations.go:515, demand.go:162-164)."""
+        resourcereservations.go:515, demand.go:162-164).  Served from
+        the owner index — the full-store scan per delete was O(cluster)
+        and the app-finished flow deletes pods constantly."""
         owner_uid = owner.meta.uid
         if not owner_uid:
             return
-        to_delete: List[Tuple[str, str, str]] = []
         with self._lock:
-            for kind, objs in self._objects.items():
-                for (ns, name), o in objs.items():
-                    if any(ref.uid == owner_uid for ref in o.meta.owner_references):
-                        to_delete.append((kind, ns, name))
+            to_delete = list(self._owner_index.get(owner_uid, ()))
         for kind, ns, name in to_delete:
             try:
                 self.delete(kind, ns, name)

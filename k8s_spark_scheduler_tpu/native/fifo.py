@@ -64,6 +64,13 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, ctypes.c_int64, _P, _P, _P, _P, _P, _P, _P,
                 _P, _P,
             ]
+            try:
+                # optional helper: a prebuilt library from an older
+                # source may lack it — that must not disable the lane
+                lib.seq_sum_f64.restype = ctypes.c_double
+                lib.seq_sum_f64.argtypes = [_P, ctypes.c_int64]
+            except AttributeError:
+                pass
             lib.fifo_solve_queue_single_az.restype = ctypes.c_int
             lib.fifo_solve_queue_single_az.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _P, _P, _P,
@@ -201,6 +208,17 @@ def solve_queue_single_az_native(
         _c(didx),
     )
     return feas.astype(bool), zone, didx, avail_io
+
+
+def seq_sum_f64_native(values: np.ndarray) -> Optional[float]:
+    """CPython-sum-compatible float64 reduction (bit-identical to
+    builtin sum() of the list — Neumaier since 3.12) or None when the
+    lib (or the symbol, in an older prebuilt) is unavailable."""
+    lib = _build_and_load()
+    if lib is None or not hasattr(lib, "seq_sum_f64"):
+        return None
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    return float(lib.seq_sum_f64(_c(v), v.shape[0]))
 
 
 def solve_app_native(

@@ -145,7 +145,16 @@ class LazyEfficiencies(dict):
         if not self._names:
             return 0.0
         maxes = np.maximum(np.maximum(self._cpu, self._mem), self._gpu)
-        return sum(maxes.tolist()) / float(len(self._names))
+        try:
+            from ..native.fifo import seq_sum_f64_native
+
+            total = seq_sum_f64_native(maxes)
+        except Exception:
+            total = None
+        if total is None:
+            # same IEEE order, Python speed (~0.6ms at 10k nodes)
+            total = sum(maxes.tolist())
+        return total / float(len(self._names))
 
 
 def efficiencies_from_rows(names, sched_rows, avail_rows, reserved_rows):
@@ -258,6 +267,42 @@ class TpuFifoSolver:
         return self.solve_tensor(
             cluster, earlier_apps, earlier_skip_allowed, current_app, metadata=metadata
         )
+
+    def feasible_tensor(self, cluster, app: AppDemand) -> Optional[bool]:
+        """Feasibility of one app against a prebuilt ClusterTensor with
+        no placement decode and no efficiency math — the
+        unschedulable-marker's empty-cluster verdict (its scan runs
+        every interval over the whole pending backlog, so the full
+        solve_tensor cost per pod was pure waste).  Feasibility is
+        policy-invariant across tightly/evenly/min-frag (the
+        work-conserving drain rule, batch_solver docstring), identical
+        to binpack_func's has_capacity.  None = not exactly
+        tensorizable (caller uses the host path)."""
+        apps = tensorize_apps([app])
+        problem = scale_problem(cluster, apps)
+        if not problem.ok:
+            return None
+        if self._use_native():
+            from ..native.fifo import solve_app_native
+
+            feas, _, _, _ = solve_app_native(
+                problem.avail, problem.driver_rank, problem.exec_ok,
+                problem.driver[0], problem.executor[0], int(problem.count[0]),
+            )
+            return bool(feas)
+        import jax.numpy as jnp
+
+        from .batch_solver import solve_single
+
+        solve = solve_single(
+            jnp.asarray(problem.avail),
+            jnp.asarray(problem.driver_rank),
+            jnp.asarray(problem.exec_ok),
+            jnp.asarray(problem.driver[0]),
+            jnp.asarray(problem.executor[0]),
+            jnp.asarray(problem.count[0]),
+        )
+        return bool(solve.feasible)
 
     def solve_tensor(
         self,

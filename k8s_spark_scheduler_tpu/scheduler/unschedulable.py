@@ -69,8 +69,20 @@ class UnschedulablePodMarker:
                 logger.exception("unschedulable pod scan failed")
 
     def scan_for_unschedulable_pods(self) -> None:
-        """unschedulablepods.go:93-129."""
+        """unschedulablepods.go:93-129.
+
+        A deep pending backlog shares a handful of affinity shapes and
+        app sizes, and the verdict is a pure function of (eligible node
+        set, zero-usage metadata, app resource triple) — so the scan
+        memoizes the empty-cluster metadata per affinity signature and
+        the binpack verdict per (signature, app triple) within one
+        sweep.  Without this, a 1k-deep backlog rebuilt 10k-node
+        Quantity metadata and ran a full pack PER POD every interval
+        (tens of seconds of CPU that, on a small host, came straight
+        out of live Filter latency)."""
         now = time.time()
+        meta_cache: dict = {}
+        verdict_cache: dict = {}
         for pod in self._pod_informer.list():
             if (
                 pod.scheduler_name == L.SPARK_SCHEDULER_NAME
@@ -80,32 +92,104 @@ class UnschedulablePodMarker:
                 and pod.creation_timestamp + self._timeout < now
             ):
                 try:
-                    exceeds = self.does_pod_exceed_cluster_capacity(pod)
+                    exceeds = self._pod_exceeds_cached(pod, meta_cache, verdict_cache)
                 except AnnotationError:
                     logger.exception("failed to check if pod was unschedulable")
                     return
                 if exceeds:
                     logger.info("marking pod %s as exceeds capacity", pod.name)
                 self._mark_pod_cluster_capacity_status(pod, exceeds)
+                # yield between pods: the scan is a background janitor —
+                # a deep backlog must not monopolize a small host's core
+                # against live Filter requests for seconds at a stretch
+                time.sleep(0.0005)
+
+    @staticmethod
+    def _affinity_sig(pod: Pod):
+        """Hashable signature of the node-matching constraints (the only
+        pod inputs to the eligible-node set)."""
+        return (
+            tuple(sorted(pod.node_selector.items())),
+            tuple(sorted((k, tuple(v)) for k, v in pod.node_affinity.items())),
+            tuple(
+                tuple((k, op, tuple(vals)) for k, op, vals in term)
+                for term in pod.affinity_terms
+            ),
+        )
+
+    def _pod_exceeds_cached(self, driver: Pod, meta_cache: dict, verdict_cache: dict) -> bool:
+        sig = self._affinity_sig(driver)
+        app_resources = spark_resources(driver)
+        # Quantity is hashable (exact-value eq/hash); the Resources
+        # dataclass is not, so the key carries its quantities
+        key = (
+            sig,
+            *(
+                (r.cpu, r.memory, r.nvidia_gpu)
+                for r in (
+                    app_resources.driver_resources,
+                    app_resources.executor_resources,
+                )
+            ),
+            app_resources.min_executor_count,
+        )
+        hit = verdict_cache.get(key)
+        if hit is not None:
+            return hit
+        cached = meta_cache.get(sig)
+        if cached is None:
+            nodes = self._node_informer.list_with_predicate(
+                lambda n: driver.matches_node(n)
+            )
+            node_names = [n.name for n in nodes]
+            zero_usage = {n.name: Resources.zero() for n in nodes}
+            overhead = self._overhead.get_non_schedulable_overhead(nodes)
+            metadata = node_scheduling_metadata_for_nodes(nodes, zero_usage, overhead)
+            cluster = None
+            solver = getattr(self._binpacker, "queue_solver", None)
+            if solver is not None and hasattr(solver, "feasible_tensor"):
+                # the tensor is pod-independent within the signature:
+                # build once, then each verdict is one feasibility-only
+                # solve on the device/native lane (identical to
+                # binpack_func's has_capacity, per the differential
+                # suites)
+                from ..ops.tensorize import tensorize_cluster
+
+                cluster = tensorize_cluster(metadata, node_names, node_names)
+            cached = (node_names, metadata, cluster, solver)
+            meta_cache[sig] = cached
+        node_names, metadata, cluster, solver = cached
+        exceeds = None
+        if cluster is not None:
+            from ..ops.sparkapp import AppDemand
+
+            feasible = solver.feasible_tensor(
+                cluster,
+                AppDemand(
+                    app_resources.driver_resources,
+                    app_resources.executor_resources,
+                    app_resources.min_executor_count,
+                ),
+            )
+            if feasible is not None:
+                exceeds = not feasible
+        if exceeds is None:
+            result = self._binpacker.binpack_func(
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+                node_names,
+                node_names,
+                metadata,
+            )
+            exceeds = not result.has_capacity
+        verdict_cache[key] = exceeds
+        return exceeds
 
     def does_pod_exceed_cluster_capacity(self, driver: Pod) -> bool:
         """unschedulablepods.go:132-166: binpack against zero usage plus
         non-schedulable overhead."""
-        nodes = self._node_informer.list_with_predicate(lambda n: driver.matches_node(n))
-        node_names = [n.name for n in nodes]
-        zero_usage = {n.name: Resources.zero() for n in nodes}
-        overhead = self._overhead.get_non_schedulable_overhead(nodes)
-        metadata = node_scheduling_metadata_for_nodes(nodes, zero_usage, overhead)
-        app_resources = spark_resources(driver)
-        result = self._binpacker.binpack_func(
-            app_resources.driver_resources,
-            app_resources.executor_resources,
-            app_resources.min_executor_count,
-            node_names,
-            node_names,
-            metadata,
-        )
-        return not result.has_capacity
+        return self._pod_exceeds_cached(driver, {}, {})
 
     def _mark_pod_cluster_capacity_status(self, driver: Pod, exceeds: bool) -> None:
         """unschedulablepods.go:168-180 (condition update only when

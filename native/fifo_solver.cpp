@@ -1007,4 +1007,27 @@ int fifo_solve_app(int64_t nb, const int32_t* avail,
   return 1;
 }
 
+// CPython-compatible float64 sum: the packing-efficiency gauge
+// contract is bit-equality with the host lane's builtin sum(), which
+// since Python 3.12 is NEUMAIER-compensated summation, not naive
+// left-to-right (and not numpy's pairwise reduction either).  This is
+// the same algorithm CPython's float fast path runs, in the same
+// order, at C speed (~0.6ms of per-request PyFloat summing removed).
+// The optimize attribute pins scalar in-order codegen.
+__attribute__((optimize("no-tree-vectorize", "no-unroll-loops")))
+double seq_sum_f64(const double* v, int64_t n) {
+  double s = 0.0, c = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    const double x = v[i];
+    const double t = s + x;
+    if (std::abs(s) >= std::abs(x)) {
+      c += (s - t) + x;
+    } else {
+      c += (x - t) + s;
+    }
+    s = t;
+  }
+  return s + c;
+}
+
 }  // extern "C"

@@ -749,3 +749,33 @@ def test_extender_tpu_batch_single_az_min_frag_matches_host():
         results["single-az-minimal-fragmentation"]
         == results["tpu-batch-single-az-minimal-fragmentation"]
     )
+
+
+def test_feasible_tensor_matches_binpack_has_capacity():
+    """The marker's feasibility-only entry point must agree with
+    binpack_func's has_capacity on random snapshots (it is the same
+    work-conserving feasibility rule with the decode skipped)."""
+    from k8s_spark_scheduler_tpu.ops.registry import select_binpacker
+    from k8s_spark_scheduler_tpu.ops.tensorize import tensorize_cluster
+
+    rng = random.Random(20260730)
+    for policy in ("tpu-batch", "tpu-batch-distribute-evenly",
+                   "tpu-batch-minimal-fragmentation"):
+        binpacker = select_binpacker(policy)
+        solver = binpacker.queue_solver
+        for _ in range(8):
+            metadata = random_cluster(rng, rng.randint(2, 12))
+            d_order, e_order = orders_for(metadata, rng)
+            app = random_app(rng)
+            cluster = tensorize_cluster(metadata, d_order, e_order)
+            feasible = solver.feasible_tensor(cluster, app)
+            result = binpacker.binpack_func(
+                app.driver_resources,
+                app.executor_resources,
+                app.min_executor_count,
+                d_order,
+                e_order,
+                metadata,
+            )
+            assert feasible is not None
+            assert feasible == result.has_capacity, policy

@@ -95,6 +95,19 @@ def test_overbooked_zero_requirement_dimension():
     np.testing.assert_array_equal(cnts, np.asarray(ref.exec_counts))
 
 
+def test_seq_sum_f64_matches_python_sequential_sum():
+    """The native sequential float64 sum must be BIT-identical to
+    summing the Python list left-to-right (the packing-efficiency gauge
+    contract; no -fassociative-math in the build flags)."""
+    from k8s_spark_scheduler_tpu.native.fifo import seq_sum_f64_native
+
+    rng = np.random.RandomState(3)
+    for n in (0, 1, 7, 1000, 10240):
+        v = rng.rand(n) * rng.choice([1e-9, 1.0, 1e9], size=n)
+        native = seq_sum_f64_native(v)
+        assert native == sum(v.tolist())
+
+
 def test_int32_extremes_in_capacity_pass():
     """The r5 dim-at-a-time pass corrects a reciprocal-multiply quotient
     with integer multiply-compares; a[i] = INT32_MAX with divisor 1 must
