@@ -92,10 +92,21 @@ class LazyEfficiencies(dict):
     def __init__(self, names, cpu, mem, gpu):
         super().__init__()
         self._names = list(names)
-        self._col_idx = dict(zip(self._names, range(len(self._names))))
+        # name → column dict built on first materialization: most
+        # requests only read the scalar average (seq_max_avg), and a
+        # 10k-entry dict per Filter is measurable on the request path
+        self._col_idx_lazy = None
         self._cpu = cpu
         self._mem = mem
         self._gpu = gpu
+
+    @property
+    def _col_idx(self):
+        if self._col_idx_lazy is None:
+            self._col_idx_lazy = dict(
+                zip(self._names, range(len(self._names)))
+            )
+        return self._col_idx_lazy
 
     def __missing__(self, name):
         from .efficiency import PackingEfficiency
@@ -247,6 +258,14 @@ class TpuFifoSolver:
         # "minfrag-xla"; None = no queue pass ran — observable for tests
         # and the tpu.fastpath lane counters
         self.last_queue_lane: Optional[str] = None
+        # (ids, strong refs, AppTensor) of the last earlier-apps list:
+        # consecutive Filters tensorize the same pending queue, and the
+        # per-request Python loop over ~1k apps is measurable.  The
+        # cached list holds strong references, so an id can never be
+        # reused while the entry lives — id-tuple equality therefore
+        # proves the SAME AppDemand objects (stable per pod version via
+        # sparkpods._cached_entry), making the hit exact.
+        self._earlier_tensor_cache = None
 
     def _use_pallas(self) -> bool:
         return _pallas_selected(self.backend)
@@ -266,6 +285,38 @@ class TpuFifoSolver:
         cluster = tensorize_cluster(metadata, driver_order, executor_order)
         return self.solve_tensor(
             cluster, earlier_apps, earlier_skip_allowed, current_app, metadata=metadata
+        )
+
+    def _tensorize_with_cache(self, earlier, current_app):
+        """AppTensor for earlier + [current]: the earlier block is
+        cached by object identity (see _earlier_tensor_cache) and the
+        current app's rows are appended."""
+        from .tensorize import AppTensor, _app_base_rows
+
+        key = tuple(map(id, earlier))
+        cached = self._earlier_tensor_cache
+        if cached is not None and cached[0] == key:
+            base = cached[2]
+        else:
+            base = tensorize_apps(earlier)
+            self._earlier_tensor_cache = (key, earlier, base)
+        drow, erow, exact = _app_base_rows(current_app)
+        a = base.driver.shape[0]
+        driver = np.empty((a + 1, 3), dtype=np.int64)
+        driver[:a] = base.driver
+        driver[a] = drow
+        executor = np.empty((a + 1, 3), dtype=np.int64)
+        executor[:a] = base.executor
+        executor[a] = erow
+        count = np.empty(a + 1, dtype=np.int64)
+        count[:a] = base.count
+        count[a] = current_app.min_executor_count
+        return AppTensor(
+            driver=driver,
+            executor=executor,
+            count=count,
+            valid=np.ones(a + 1, dtype=bool),
+            exact=base.exact and exact,
         )
 
     def feasible_tensor(self, cluster, app: AppDemand) -> Optional[bool]:
@@ -319,7 +370,7 @@ class TpuFifoSolver:
 
         from .batch_solver import solve_queue, solve_queue_min_frag, solve_single
 
-        apps = tensorize_apps(list(earlier_apps) + [current_app])
+        apps = self._tensorize_with_cache(list(earlier_apps), current_app)
         self.last_queue_lane = None
         problem = scale_problem(cluster, apps)
         if not problem.ok:
