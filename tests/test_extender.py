@@ -648,3 +648,32 @@ def test_executor_rebind_storm():
         h.assert_failure(h.schedule(extra, nodes))
     finally:
         h.close()
+
+
+def test_unschedulable_scan_memoizes_per_affinity_group(harness):
+    """The r5 scan memoization must keep per-group verdicts separate: a
+    gang that exceeds its own (small) instance group's capacity is
+    flagged even when another group could fit it, and vice versa."""
+    for i in range(2):
+        harness.new_node(f"big-{i}", cpu="32", memory="64Gi", instance_group="big")
+    harness.new_node("small-0", cpu="2", memory="4Gi", instance_group="small")
+
+    old = time.time() - 3600
+    fits_big = harness.static_allocation_spark_pods(
+        "app-big", 4, instance_group="big", creation_timestamp=old
+    )[0]
+    too_big_for_small = harness.static_allocation_spark_pods(
+        "app-small", 4, instance_group="small", creation_timestamp=old
+    )[0]
+    harness.create_pod(fits_big)
+    harness.create_pod(too_big_for_small)
+    harness.unschedulable_marker.scan_for_unschedulable_pods()
+
+    cond_big = harness.api.get("Pod", "default", fits_big.name).conditions.get(
+        "PodExceedsClusterCapacity"
+    )
+    cond_small = harness.api.get(
+        "Pod", "default", too_big_for_small.name
+    ).conditions.get("PodExceedsClusterCapacity")
+    assert cond_big is not None and cond_big.status == "False"
+    assert cond_small is not None and cond_small.status == "True"

@@ -374,3 +374,25 @@ def test_uid_less_unknown_pod_rejected(served):
     assert result["FailedNodes"]
     time.sleep(0.2)
     assert not api.list("ResourceReservation")
+
+
+def test_readiness_gates_on_solver_warmup(served):
+    """Readiness must report not-ready while the solver warmup is still
+    compiling (its compiler threads would otherwise contend with the
+    first Filters), and flip ready when it completes (r5)."""
+    import threading
+
+    _, scheduler, http = served
+    ev = getattr(scheduler, "_warm_done", None)
+    assert ev is None or ev.is_set()  # CPU-host warmup finishes fast
+    # simulate an in-flight warmup
+    scheduler._warm_done = threading.Event()
+    try:
+        assert not scheduler.warmup_complete()
+        assert _get(http.port, "/status/readiness")[0] == 503
+        scheduler._warm_done.set()
+        assert scheduler.warmup_complete()
+        assert _get(http.port, "/status/readiness")[0] == 200
+        assert scheduler.wait_ready(timeout=5.0)
+    finally:
+        scheduler._warm_done.set()
