@@ -779,3 +779,35 @@ def test_feasible_tensor_matches_binpack_has_capacity():
             )
             assert feasible is not None
             assert feasible == result.has_capacity, policy
+
+
+def test_earlier_tensor_cache_hit_matches_fresh_solver():
+    """Repeated solve_tensor calls with the SAME earlier-apps list (the
+    steady-state Filter pattern the identity cache serves) must decide
+    identically to a fresh solver, including after availability-
+    irrelevant re-solves."""
+    from k8s_spark_scheduler_tpu.ops.registry import select_binpacker
+    from k8s_spark_scheduler_tpu.ops.tensorize import tensorize_cluster
+
+    rng = random.Random(7)
+    metadata = random_cluster(rng, 10)
+    d_order, e_order = orders_for(metadata, rng)
+    cluster = tensorize_cluster(metadata, d_order, e_order)
+    earlier = [random_app(rng) for _ in range(5)]
+    skip = [False] * len(earlier)
+    current = random_app(rng)
+
+    warm = select_binpacker("tpu-batch").queue_solver
+    outs = [
+        warm.solve_tensor(cluster, earlier, skip, current) for _ in range(3)
+    ]
+    fresh = TpuFifoSolver(assignment_policy="tightly-pack").solve_tensor(
+        cluster, earlier, skip, current
+    )
+    for out in outs:
+        assert out.supported == fresh.supported
+        assert out.earlier_ok == fresh.earlier_ok
+        if fresh.result is not None:
+            assert out.result.has_capacity == fresh.result.has_capacity
+            assert out.result.driver_node == fresh.result.driver_node
+            assert out.result.executor_nodes == fresh.result.executor_nodes
