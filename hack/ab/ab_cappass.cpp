@@ -1,0 +1,173 @@
+// A/B harness: cap_pass variants at 10k nodes, 3 dims nonzero.
+//   v0: committed fused divpd (3 dims in one loop)
+//   v1: dim-at-a-time reciprocal-multiply with exact int correction
+//   v2: dim-at-a-time divpd
+//   v3: fused reciprocal-multiply (r4's rejected shape, as control)
+// Build: g++ -O3 -march=native -o /tmp/ab_cappass /tmp/ab_cappass.cpp
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+constexpr int32_t kBig = 2147483647;
+
+// v0: the committed shape
+int64_t v0(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* exec_ok, int64_t nb, double de0, double de1,
+           double de2, int32_t k, int32_t* cap) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = k;
+    c = std::min(c, static_cast<int32_t>(a0[i] / de0));
+    c = std::min(c, static_cast<int32_t>(a1[i] / de1));
+    c = std::min(c, static_cast<int32_t>(a2[i] / de2));
+    c = exec_ok[i] ? c : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+// one dim of v1: cap[i] = min(cap[i], floor(a[i]/e)) for a[i] >= 0;
+// negative a gives negative q -> min keeps it (clamped at the end).
+// q = (int)(a * inv) may be off by 1 either way; correct with two
+// integer multiply-compares (int64 to dodge overflow).
+static inline void dim_pass_recip(const int32_t* a, int64_t nb, int32_t e,
+                                  int32_t* cap) {
+  const double inv = 1.0 / static_cast<double>(e);
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q = static_cast<int32_t>(static_cast<double>(a[i]) * inv);
+    q += (static_cast<int64_t>(q + 1) * e <= a[i]);
+    q -= (static_cast<int64_t>(q) * e > a[i]);
+    cap[i] = std::min(cap[i], q);
+  }
+}
+
+static inline void dim_pass_div(const int32_t* a, int64_t nb, double de,
+                                int32_t* cap) {
+  for (int64_t i = 0; i < nb; ++i) {
+    cap[i] = std::min(cap[i], static_cast<int32_t>(a[i] / de));
+  }
+}
+
+int64_t v1(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* exec_ok, int64_t nb, int32_t e0, int32_t e1,
+           int32_t e2, int32_t k, int32_t* cap) {
+  for (int64_t i = 0; i < nb; ++i) cap[i] = k;
+  dim_pass_recip(a0, nb, e0, cap);
+  dim_pass_recip(a1, nb, e1, cap);
+  dim_pass_recip(a2, nb, e2, cap);
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = exec_ok[i] ? cap[i] : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+int64_t v2(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* exec_ok, int64_t nb, double de0, double de1,
+           double de2, int32_t k, int32_t* cap) {
+  for (int64_t i = 0; i < nb; ++i) cap[i] = k;
+  dim_pass_div(a0, nb, de0, cap);
+  dim_pass_div(a1, nb, de1, cap);
+  dim_pass_div(a2, nb, de2, cap);
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = exec_ok[i] ? cap[i] : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+int64_t v3(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* exec_ok, int64_t nb, int32_t e0, int32_t e1,
+           int32_t e2, int32_t k, int32_t* cap) {
+  const double i0 = 1.0 / e0, i1 = 1.0 / e1, i2 = 1.0 / e2;
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q0 = static_cast<int32_t>(static_cast<double>(a0[i]) * i0);
+    q0 += (static_cast<int64_t>(q0 + 1) * e0 <= a0[i]);
+    q0 -= (static_cast<int64_t>(q0) * e0 > a0[i]);
+    int32_t q1 = static_cast<int32_t>(static_cast<double>(a1[i]) * i1);
+    q1 += (static_cast<int64_t>(q1 + 1) * e1 <= a1[i]);
+    q1 -= (static_cast<int64_t>(q1) * e1 > a1[i]);
+    int32_t q2 = static_cast<int32_t>(static_cast<double>(a2[i]) * i2);
+    q2 += (static_cast<int64_t>(q2 + 1) * e2 <= a2[i]);
+    q2 -= (static_cast<int64_t>(q2) * e2 > a2[i]);
+    int32_t c = std::min(std::min(q0, q1), std::min(q2, k));
+    c = exec_ok[i] ? c : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+int main(int argc, char** argv) {
+  const int64_t nb = argc > 1 ? atoll(argv[1]) : 10000;
+  const int reps = argc > 2 ? atoi(argv[2]) : 2000;
+  std::mt19937 rng(7);
+  std::vector<int32_t> a0(nb), a1(nb), a2(nb), cap(nb), ref(nb);
+  std::vector<uint8_t> ok(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    a0[i] = static_cast<int32_t>(rng() % 96000) - 2000;
+    a1[i] = static_cast<int32_t>(rng() % (256u << 20)) - 4096;
+    a2[i] = static_cast<int32_t>(rng() % 8000) - 1000;
+    ok[i] = (rng() % 100) < 97;
+  }
+  const int32_t e0 = 4500, e1 = 9 << 20, e2 = 1000, k = 17;
+  const double de0 = e0, de1 = e1, de2 = e2;
+
+  // correctness: all variants must agree
+  int64_t t0s = v0(a0.data(), a1.data(), a2.data(), ok.data(), nb, de0, de1,
+                   de2, k, ref.data());
+  int64_t t1s = v1(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1,
+                   e2, k, cap.data());
+  for (int64_t i = 0; i < nb; ++i)
+    if (cap[i] != ref[i]) { printf("v1 MISMATCH at %lld\n", (long long)i); return 1; }
+  int64_t t2s = v2(a0.data(), a1.data(), a2.data(), ok.data(), nb, de0, de1,
+                   de2, k, cap.data());
+  for (int64_t i = 0; i < nb; ++i)
+    if (cap[i] != ref[i]) { printf("v2 MISMATCH at %lld\n", (long long)i); return 1; }
+  int64_t t3s = v3(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1,
+                   e2, k, cap.data());
+  for (int64_t i = 0; i < nb; ++i)
+    if (cap[i] != ref[i]) { printf("v3 MISMATCH at %lld\n", (long long)i); return 1; }
+  if (t0s != t1s || t0s != t2s || t0s != t3s) { printf("total mismatch\n"); return 1; }
+
+  auto bench = [&](const char* name, auto fn) {
+    volatile int64_t sink = 0;
+    auto t0 = std::chrono::steady_clock::now();
+    for (int r = 0; r < reps; ++r) sink += fn();
+    auto t1 = std::chrono::steady_clock::now();
+    double us =
+        std::chrono::duration<double, std::micro>(t1 - t0).count() / reps;
+    printf("%s: %.2f us/pass (%lld)\n", name, us, (long long)sink);
+  };
+  bench("v0 fused-divpd   ", [&] {
+    return v0(a0.data(), a1.data(), a2.data(), ok.data(), nb, de0, de1, de2,
+              k, cap.data());
+  });
+  bench("v1 dim-recip     ", [&] {
+    return v1(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, e2, k,
+              cap.data());
+  });
+  bench("v2 dim-divpd     ", [&] {
+    return v2(a0.data(), a1.data(), a2.data(), ok.data(), nb, de0, de1, de2,
+              k, cap.data());
+  });
+  bench("v3 fused-recip   ", [&] {
+    return v3(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, e2, k,
+              cap.data());
+  });
+  return 0;
+}

@@ -1,0 +1,141 @@
+// Round 2: tighten the dim-at-a-time shape.
+//   v1: 5 passes (init, 3 dims, finalize)   [winner of round 1]
+//   v4: 3 passes (dim0 folds init, dim2 folds finalize)
+//   v5: v4 with divpd instead of recip
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+static inline void dim_pass_recip(const int32_t* a, int64_t nb, int32_t e,
+                                  int32_t* cap) {
+  const double inv = 1.0 / static_cast<double>(e);
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q = static_cast<int32_t>(static_cast<double>(a[i]) * inv);
+    q += (static_cast<int64_t>(q + 1) * e <= a[i]);
+    q -= (static_cast<int64_t>(q) * e > a[i]);
+    cap[i] = std::min(cap[i], q);
+  }
+}
+
+int64_t v1(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* exec_ok, int64_t nb, int32_t e0, int32_t e1,
+           int32_t e2, int32_t k, int32_t* cap) {
+  for (int64_t i = 0; i < nb; ++i) cap[i] = k;
+  dim_pass_recip(a0, nb, e0, cap);
+  dim_pass_recip(a1, nb, e1, cap);
+  dim_pass_recip(a2, nb, e2, cap);
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = exec_ok[i] ? cap[i] : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+static inline void dim_first_recip(const int32_t* a, int64_t nb, int32_t e,
+                                   int32_t k, int32_t* cap) {
+  const double inv = 1.0 / static_cast<double>(e);
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q = static_cast<int32_t>(static_cast<double>(a[i]) * inv);
+    q += (static_cast<int64_t>(q + 1) * e <= a[i]);
+    q -= (static_cast<int64_t>(q) * e > a[i]);
+    cap[i] = std::min(k, q);
+  }
+}
+
+static inline int64_t dim_last_recip(const int32_t* a, int64_t nb, int32_t e,
+                                     const uint8_t* exec_ok, int32_t* cap) {
+  const double inv = 1.0 / static_cast<double>(e);
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q = static_cast<int32_t>(static_cast<double>(a[i]) * inv);
+    q += (static_cast<int64_t>(q + 1) * e <= a[i]);
+    q -= (static_cast<int64_t>(q) * e > a[i]);
+    int32_t c = std::min(cap[i], q);
+    c = exec_ok[i] ? c : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+int64_t v4(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* exec_ok, int64_t nb, int32_t e0, int32_t e1,
+           int32_t e2, int32_t k, int32_t* cap) {
+  dim_first_recip(a0, nb, e0, k, cap);
+  dim_pass_recip(a1, nb, e1, cap);
+  return dim_last_recip(a2, nb, e2, exec_ok, cap);
+}
+
+static inline void dim_first_div(const int32_t* a, int64_t nb, double de,
+                                 int32_t k, int32_t* cap) {
+  for (int64_t i = 0; i < nb; ++i)
+    cap[i] = std::min(k, static_cast<int32_t>(a[i] / de));
+}
+static inline void dim_pass_div(const int32_t* a, int64_t nb, double de,
+                                int32_t* cap) {
+  for (int64_t i = 0; i < nb; ++i)
+    cap[i] = std::min(cap[i], static_cast<int32_t>(a[i] / de));
+}
+static inline int64_t dim_last_div(const int32_t* a, int64_t nb, double de,
+                                   const uint8_t* exec_ok, int32_t* cap) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = std::min(cap[i], static_cast<int32_t>(a[i] / de));
+    c = exec_ok[i] ? c : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+int64_t v5(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* exec_ok, int64_t nb, double de0, double de1,
+           double de2, int32_t k, int32_t* cap) {
+  dim_first_div(a0, nb, de0, k, cap);
+  dim_pass_div(a1, nb, de1, cap);
+  return dim_last_div(a2, nb, de2, exec_ok, cap);
+}
+
+int main(int argc, char** argv) {
+  const int64_t nb = argc > 1 ? atoll(argv[1]) : 10000;
+  const int reps = argc > 2 ? atoi(argv[2]) : 3000;
+  std::mt19937 rng(7);
+  std::vector<int32_t> a0(nb), a1(nb), a2(nb), cap(nb), ref(nb);
+  std::vector<uint8_t> ok(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    a0[i] = static_cast<int32_t>(rng() % 96000) - 2000;
+    a1[i] = static_cast<int32_t>(rng() % (256u << 20)) - 4096;
+    a2[i] = static_cast<int32_t>(rng() % 8000) - 1000;
+    ok[i] = (rng() % 100) < 97;
+  }
+  const int32_t e0 = 4500, e1 = 9 << 20, e2 = 1000, k = 17;
+
+  int64_t t1 = v1(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, e2, k, ref.data());
+  int64_t t4 = v4(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, e2, k, cap.data());
+  for (int64_t i = 0; i < nb; ++i) if (cap[i] != ref[i]) { printf("v4 MISMATCH\n"); return 1; }
+  int64_t t5 = v5(a0.data(), a1.data(), a2.data(), ok.data(), nb, (double)e0, (double)e1, (double)e2, k, cap.data());
+  for (int64_t i = 0; i < nb; ++i) if (cap[i] != ref[i]) { printf("v5 MISMATCH\n"); return 1; }
+  if (t1 != t4 || t1 != t5) { printf("total mismatch\n"); return 1; }
+
+  auto bench = [&](const char* name, auto fn) {
+    volatile int64_t sink = 0;
+    auto s = std::chrono::steady_clock::now();
+    for (int r = 0; r < reps; ++r) sink += fn();
+    auto e = std::chrono::steady_clock::now();
+    printf("%s: %.2f us/pass (%lld)\n", name,
+           std::chrono::duration<double, std::micro>(e - s).count() / reps,
+           (long long)sink);
+  };
+  bench("v1 5-pass recip", [&] { return v1(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, e2, k, cap.data()); });
+  bench("v4 3-pass recip", [&] { return v4(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, e2, k, cap.data()); });
+  bench("v5 3-pass divpd", [&] { return v5(a0.data(), a1.data(), a2.data(), ok.data(), nb, (double)e0, (double)e1, (double)e2, k, cap.data()); });
+  return 0;
+}

@@ -1,0 +1,133 @@
+// Round 3: common-case fusion for 2 division dims + 1 zero dim.
+//   vA: current shape: dim_first(a0), dim_next(a1), zero_mask(a2), finalize  (4 passes)
+//   vB: dim_first(a0), fused[dim_next(a1) + zero_mask(a2) + exec_ok + clamp + total]  (2 passes)
+//   vC: fully fused single pass (control; expect register-pressure loss)
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+static inline void dim_first(const int32_t* a, int64_t nb, int32_t e,
+                             int32_t init, int32_t* cap) {
+  const int32_t d = std::max(e, 1);
+  const double inv = 1.0 / static_cast<double>(d);
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q = static_cast<int32_t>(static_cast<double>(a[i]) * inv);
+    q += ((static_cast<int64_t>(q) + 1) * d <= a[i]);
+    q -= (static_cast<int64_t>(q) * d > a[i]);
+    cap[i] = std::min(init, q);
+  }
+}
+static inline void dim_next(const int32_t* a, int64_t nb, int32_t e, int32_t* cap) {
+  const int32_t d = std::max(e, 1);
+  const double inv = 1.0 / static_cast<double>(d);
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q = static_cast<int32_t>(static_cast<double>(a[i]) * inv);
+    q += ((static_cast<int64_t>(q) + 1) * d <= a[i]);
+    q -= (static_cast<int64_t>(q) * d > a[i]);
+    cap[i] = std::min(cap[i], q);
+  }
+}
+static inline void zero_mask(const int32_t* a, int64_t nb, int32_t* cap) {
+  for (int64_t i = 0; i < nb; ++i) cap[i] = a[i] >= 0 ? cap[i] : int32_t{-1};
+}
+static inline int64_t finalize(const uint8_t* ok, int64_t nb, int32_t* cap) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t c = ok[i] ? cap[i] : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+int64_t vA(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* ok, int64_t nb, int32_t e0, int32_t e1, int32_t k,
+           int32_t* cap) {
+  dim_first(a0, nb, e0, k, cap);
+  dim_next(a1, nb, e1, cap);
+  zero_mask(a2, nb, cap);
+  return finalize(ok, nb, cap);
+}
+
+int64_t vB(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* ok, int64_t nb, int32_t e0, int32_t e1, int32_t k,
+           int32_t* cap) {
+  dim_first(a0, nb, e0, k, cap);
+  const int32_t d = std::max(e1, 1);
+  const double inv = 1.0 / static_cast<double>(d);
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q = static_cast<int32_t>(static_cast<double>(a1[i]) * inv);
+    q += ((static_cast<int64_t>(q) + 1) * d <= a1[i]);
+    q -= (static_cast<int64_t>(q) * d > a1[i]);
+    int32_t c = std::min(cap[i], q);
+    c = a2[i] >= 0 ? c : int32_t{-1};
+    c = ok[i] ? c : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+int64_t vC(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+           const uint8_t* ok, int64_t nb, int32_t e0, int32_t e1, int32_t k,
+           int32_t* cap) {
+  const int32_t d0 = std::max(e0, 1), d1 = std::max(e1, 1);
+  const double i0 = 1.0 / d0, i1 = 1.0 / d1;
+  int64_t total = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    int32_t q0 = static_cast<int32_t>(static_cast<double>(a0[i]) * i0);
+    q0 += ((static_cast<int64_t>(q0) + 1) * d0 <= a0[i]);
+    q0 -= (static_cast<int64_t>(q0) * d0 > a0[i]);
+    int32_t q1 = static_cast<int32_t>(static_cast<double>(a1[i]) * i1);
+    q1 += ((static_cast<int64_t>(q1) + 1) * d1 <= a1[i]);
+    q1 -= (static_cast<int64_t>(q1) * d1 > a1[i]);
+    int32_t c = std::min(std::min(q0, q1), k);
+    c = a2[i] >= 0 ? c : int32_t{-1};
+    c = ok[i] ? c : 0;
+    c = std::max(c, 0);
+    cap[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+int main(int argc, char** argv) {
+  const int64_t nb = argc > 1 ? atoll(argv[1]) : 10000;
+  const int reps = argc > 2 ? atoi(argv[2]) : 4000;
+  std::mt19937 rng(7);
+  std::vector<int32_t> a0(nb), a1(nb), a2(nb), cap(nb), ref(nb);
+  std::vector<uint8_t> ok(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    a0[i] = static_cast<int32_t>(rng() % 96000) - 2000;
+    a1[i] = static_cast<int32_t>(rng() % (256u << 20)) - 4096;
+    a2[i] = static_cast<int32_t>(rng() % 100) - 5;
+    ok[i] = (rng() % 100) < 97;
+  }
+  const int32_t e0 = 4500, e1 = 9 << 20, k = 17;
+  int64_t tA = vA(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, k, ref.data());
+  int64_t tB = vB(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, k, cap.data());
+  for (int64_t i = 0; i < nb; ++i) if (cap[i] != ref[i]) { printf("vB MISMATCH\n"); return 1; }
+  int64_t tC = vC(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, k, cap.data());
+  for (int64_t i = 0; i < nb; ++i) if (cap[i] != ref[i]) { printf("vC MISMATCH\n"); return 1; }
+  if (tA != tB || tA != tC) { printf("total mismatch\n"); return 1; }
+  auto bench = [&](const char* name, auto fn) {
+    volatile int64_t sink = 0;
+    auto s = std::chrono::steady_clock::now();
+    for (int r = 0; r < reps; ++r) sink += fn();
+    auto e = std::chrono::steady_clock::now();
+    printf("%s: %.2f us/pass (%lld)\n", name,
+           std::chrono::duration<double, std::micro>(e - s).count() / reps,
+           (long long)sink);
+  };
+  bench("vA 4-pass        ", [&]{ return vA(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, k, cap.data()); });
+  bench("vB 2-pass fused  ", [&]{ return vB(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, k, cap.data()); });
+  bench("vC 1-pass fused  ", [&]{ return vC(a0.data(), a1.data(), a2.data(), ok.data(), nb, e0, e1, k, cap.data()); });
+  return 0;
+}

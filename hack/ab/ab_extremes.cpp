@@ -1,0 +1,52 @@
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <random>
+#include <vector>
+constexpr int32_t kBig = 2147483647;
+struct MfExtremes { int32_t maxc = 0, min_ge = kBig, min_pos = kBig; };
+
+MfExtremes v0(const std::vector<int32_t>& caps, int32_t k) {
+  MfExtremes ext;
+  for (const int32_t c : caps) {
+    ext.maxc = std::max(ext.maxc, c);
+    ext.min_ge = std::min(ext.min_ge, c >= k ? c : kBig);
+    ext.min_pos = std::min(ext.min_pos, c > 0 ? c : kBig);
+  }
+  return ext;
+}
+MfExtremes v1(const std::vector<int32_t>& caps, int32_t k) {
+  MfExtremes ext;
+  const int32_t* p = caps.data();
+  const int64_t n = caps.size();
+  int32_t maxc = 0;
+  for (int64_t i = 0; i < n; ++i) maxc = std::max(maxc, p[i]);
+  int32_t mge = kBig;
+  for (int64_t i = 0; i < n; ++i) mge = std::min(mge, p[i] >= k ? p[i] : kBig);
+  int32_t mpos = kBig;
+  for (int64_t i = 0; i < n; ++i) mpos = std::min(mpos, p[i] > 0 ? p[i] : kBig);
+  ext.maxc = maxc; ext.min_ge = mge; ext.min_pos = mpos;
+  return ext;
+}
+int main() {
+  const int64_t nb = 10240;
+  std::mt19937 rng(7);
+  std::vector<int32_t> caps(nb);
+  for (auto& c : caps) c = (int32_t)(rng() % 120) - 10;
+  MfExtremes a = v0(caps, 17), b = v1(caps, 17);
+  if (a.maxc != b.maxc || a.min_ge != b.min_ge || a.min_pos != b.min_pos) { printf("MISMATCH\n"); return 1; }
+  for (int which = 0; which < 2; ++which) {
+    volatile int64_t sink = 0;
+    auto t0 = std::chrono::steady_clock::now();
+    for (int r = 0; r < 20000; ++r) {
+      MfExtremes e = which ? v1(caps, 17) : v0(caps, 17);
+      sink += e.maxc + e.min_ge + e.min_pos;
+    }
+    auto t1 = std::chrono::steady_clock::now();
+    printf("v%d: %.2f us/pass (%lld)\n", which,
+           std::chrono::duration<double, std::micro>(t1 - t0).count() / 20000,
+           (long long)sink);
+  }
+  return 0;
+}

@@ -1,0 +1,81 @@
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <random>
+#include <vector>
+constexpr int32_t kBig = 2147483647;
+struct MfExtremes { int32_t maxc = 0, min_ge = kBig, min_pos = kBig; };
+
+__attribute__((noinline)) int32_t pure_max(const int32_t* p, int64_t n) {
+  int32_t m = 0;
+  for (int64_t i = 0; i < n; ++i) m = std::max(m, p[i]);
+  return m;
+}
+__attribute__((noinline)) int32_t pure_min(const int32_t* p, int64_t n) {
+  int32_t m = kBig;
+  for (int64_t i = 0; i < n; ++i) m = std::min(m, p[i]);
+  return m;
+}
+// map+reduce: select into scratch (vectorizes), then pure min
+MfExtremes v2(const std::vector<int32_t>& caps, int32_t k,
+              std::vector<int32_t>& scratch) {
+  MfExtremes ext;
+  const int32_t* p = caps.data();
+  const int64_t n = caps.size();
+  ext.maxc = pure_max(p, n);
+  int32_t* s = scratch.data();
+  for (int64_t i = 0; i < n; ++i) s[i] = p[i] >= k ? p[i] : kBig;
+  ext.min_ge = pure_min(s, n);
+  for (int64_t i = 0; i < n; ++i) s[i] = p[i] > 0 ? p[i] : kBig;
+  ext.min_pos = pure_min(s, n);
+  return ext;
+}
+// omp simd reductions
+MfExtremes v3(const std::vector<int32_t>& caps, int32_t k) {
+  MfExtremes ext;
+  const int32_t* p = caps.data();
+  const int64_t n = caps.size();
+  int32_t maxc = 0, mge = kBig, mpos = kBig;
+  #pragma omp simd reduction(max:maxc) reduction(min:mge) reduction(min:mpos)
+  for (int64_t i = 0; i < n; ++i) {
+    maxc = std::max(maxc, p[i]);
+    mge = std::min(mge, p[i] >= k ? p[i] : kBig);
+    mpos = std::min(mpos, p[i] > 0 ? p[i] : kBig);
+  }
+  ext.maxc = maxc; ext.min_ge = mge; ext.min_pos = mpos;
+  return ext;
+}
+MfExtremes v0(const std::vector<int32_t>& caps, int32_t k) {
+  MfExtremes ext;
+  for (const int32_t c : caps) {
+    ext.maxc = std::max(ext.maxc, c);
+    ext.min_ge = std::min(ext.min_ge, c >= k ? c : kBig);
+    ext.min_pos = std::min(ext.min_pos, c > 0 ? c : kBig);
+  }
+  return ext;
+}
+int main() {
+  const int64_t nb = 10240;
+  std::mt19937 rng(7);
+  std::vector<int32_t> caps(nb), scratch(nb);
+  for (auto& c : caps) c = (int32_t)(rng() % 120) - 10;
+  MfExtremes a = v0(caps, 17);
+  MfExtremes b2 = v2(caps, 17, scratch);
+  MfExtremes b3 = v3(caps, 17);
+  for (auto* x : {&b2, &b3})
+    if (a.maxc != x->maxc || a.min_ge != x->min_ge || a.min_pos != x->min_pos) { printf("MISMATCH\n"); return 1; }
+  auto bench = [&](const char* nm, auto fn) {
+    volatile int64_t sink = 0;
+    auto t0 = std::chrono::steady_clock::now();
+    for (int r = 0; r < 20000; ++r) { MfExtremes e = fn(); sink += e.maxc + e.min_ge + e.min_pos; }
+    auto t1 = std::chrono::steady_clock::now();
+    printf("%s: %.2f us/pass (%lld)\n", nm,
+           std::chrono::duration<double, std::micro>(t1 - t0).count() / 20000,
+           (long long)sink);
+  };
+  bench("v0 fused   ", [&]{ return v0(caps, 17); });
+  bench("v2 map+red ", [&]{ return v2(caps, 17, scratch); });
+  bench("v3 omp simd", [&]{ return v3(caps, 17); });
+  return 0;
+}

@@ -1,0 +1,185 @@
+// Instrumented copy of the min-frag queue loop: loads the real bench
+// arrays, times each phase, counts attempt outcomes.
+// Build: g++ -O3 -march=native -funroll-loops -fno-math-errno \
+//   -fno-trapping-math -I/root/repo/native -DMF_HARNESS \
+//   -o /tmp/mf_harness /tmp/mf_harness.cpp
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+// pull in the real implementation (anonymous-namespace helpers included)
+#include "fifo_solver.cpp"
+
+static std::vector<char> slurp(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { perror(path); exit(1); }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> v(n);
+  if (fread(v.data(), 1, n, f) != (size_t)n) { perror("fread"); exit(1); }
+  fclose(f);
+  return v;
+}
+
+using Clock = std::chrono::steady_clock;
+static double us(Clock::time_point a, Clock::time_point b) {
+  return std::chrono::duration<double, std::micro>(b - a).count();
+}
+
+int main() {
+  auto avail_raw = slurp("/tmp/mfdump/avail.bin");
+  auto rank_raw = slurp("/tmp/mfdump/driver_rank.bin");
+  auto eok_raw = slurp("/tmp/mfdump/exec_ok.bin");
+  auto drv_raw = slurp("/tmp/mfdump/driver.bin");
+  auto exe_raw = slurp("/tmp/mfdump/executor.bin");
+  auto cnt_raw = slurp("/tmp/mfdump/count.bin");
+  auto val_raw = slurp("/tmp/mfdump/app_valid.bin");
+  const int64_t nb = rank_raw.size() / 4;
+  const int64_t na = cnt_raw.size() / 4;
+  printf("nb=%lld na=%lld\n", (long long)nb, (long long)na);
+  const int32_t* driver_rank = (const int32_t*)rank_raw.data();
+  const uint8_t* exec_ok = (const uint8_t*)eok_raw.data();
+  const int32_t* drivers = (const int32_t*)drv_raw.data();
+  const int32_t* executors = (const int32_t*)exe_raw.data();
+  const int32_t* counts = (const int32_t*)cnt_raw.data();
+  const uint8_t* app_valid = (const uint8_t*)val_raw.data();
+
+  std::vector<uint8_t> feas(na);
+  std::vector<int32_t> didx(na);
+
+  // whole-solve baseline timing via the real entry points
+  for (int what = 0; what < 2; ++what) {
+    double best = 1e18;
+    for (int rep = 0; rep < 8; ++rep) {
+      std::vector<char> avail_copy = avail_raw;
+      auto t0 = Clock::now();
+      if (what == 0)
+        fifo_solve_queue(nb, na, (int32_t*)avail_copy.data(), driver_rank,
+                         exec_ok, drivers, executors, counts, app_valid, 0,
+                         feas.data(), didx.data());
+      else
+        fifo_solve_queue_minfrag(nb, na, (int32_t*)avail_copy.data(),
+                                 driver_rank, exec_ok, drivers, executors,
+                                 counts, app_valid, feas.data(), didx.data());
+      auto t1 = Clock::now();
+      double ms = us(t0, t1) / 1000.0;
+      if (ms < best) best = ms;
+    }
+    int fcount = 0;
+    for (int64_t i = 0; i < na; ++i) fcount += feas[i];
+    printf("%s: best %.1f ms (feasible %d)\n",
+           what == 0 ? "tightly" : "minfrag", best, fcount);
+  }
+
+  // phase-instrumented replica of fifo_solve_queue_minfrag
+  {
+    std::vector<char> avail_copy = avail_raw;
+    int32_t* avail_io = (int32_t*)avail_copy.data();
+    std::vector<int32_t> cand;
+    cand.reserve(nb);
+    for (int64_t i = 0; i < nb; ++i)
+      if (driver_rank[i] < kBig) cand.push_back((int32_t)i);
+    std::sort(cand.begin(), cand.end(), [&](int32_t x, int32_t y) {
+      return driver_rank[x] < driver_rank[y];
+    });
+    std::vector<int32_t> a0(nb), a1(nb), a2(nb);
+    for (int64_t i = 0; i < nb; ++i) {
+      a0[i] = avail_io[i * 3 + 0];
+      a1[i] = avail_io[i * 3 + 1];
+      a2[i] = avail_io[i * 3 + 2];
+    }
+    std::vector<int32_t> mf_caps(nb);
+    MfScratch ws;
+    MfSegs segs;
+    double t_pass = 0, t_ext = 0, t_drv = 0, t_assign = 0, t_sub = 0;
+    long n_instant = 0, n_drain = 0, n_subset_drain = 0;
+    for (int64_t ai = 0; ai < na; ++ai) {
+      const int32_t* d = drivers + ai * 3;
+      const int32_t* e = executors + ai * 3;
+      const int32_t k = counts[ai];
+      if (!app_valid[ai]) continue;
+      auto p0 = Clock::now();
+      int64_t total = mf_cap_pass_all(a0.data(), a1.data(), a2.data(),
+                                      exec_ok, nb, e, k, mf_caps.data());
+      auto p1 = Clock::now();
+      t_pass += us(p0, p1);
+      int32_t dd = -1;
+      if (total >= k) {
+        for (int32_t i : cand) {
+          int32_t a[3] = {a0[i], a1[i], a2[i]};
+          if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+          int32_t am[3];
+          for (int j = 0; j < 3; ++j) am[j] = wrap_sub(a[j], d[j]);
+          int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+          if (total - std::clamp<int32_t>(mf_caps[i], 0, k) + cwd >= k) {
+            dd = i;
+            break;
+          }
+        }
+      }
+      auto p2 = Clock::now();
+      t_drv += us(p1, p2);
+      if (dd < 0) continue;
+      if (exec_ok[dd]) {
+        int32_t av[3];
+        for (int j = 0; j < 3; ++j)
+          av[j] = wrap_sub((j == 0 ? a0 : j == 1 ? a1 : a2)[dd], d[j]);
+        mf_caps[dd] = mf_cap_one(av[0], av[1], av[2], e);
+      }
+      auto p3 = Clock::now();
+      MfExtremes ext = mf_extremes(mf_caps, k, ws.copy);
+      auto p4 = Clock::now();
+      t_ext += us(p3, p4);
+      // inline mf_assign with outcome counting
+      segs.clear();
+      bool placed = false;
+      {
+        const bool has_sent = ext.maxc == kMfSent;
+        const bool attempt_subset = has_sent || k < ext.maxc;
+        const int64_t target =
+            has_sent ? (int64_t)kMfSent
+                     : (attempt_subset ? (k + (int64_t)ext.maxc) / 2 : 0);
+        const bool have_ge = ext.min_ge != kBig && ext.min_ge >= k;
+        if (attempt_subset && have_ge && ext.min_ge < target) {
+          ++n_instant;
+        } else if (attempt_subset && ext.min_pos != kBig &&
+                   ext.min_pos < target) {
+          ++n_subset_drain;
+        } else {
+          ++n_drain;
+        }
+        placed = k > 0 && mf_assign(mf_caps, k, ext, ws, segs);
+      }
+      auto p5 = Clock::now();
+      t_assign += us(p4, p5);
+      bool dhe = false;
+      if (placed) {
+        for (const auto& seg : segs) {
+          const int32_t i = seg.first;
+          if (i == dd) dhe = true;
+          a0[i] = wrap_sub(a0[i], e[0]);
+          a1[i] = wrap_sub(a1[i], e[1]);
+          a2[i] = wrap_sub(a2[i], e[2]);
+        }
+      }
+      if (!dhe) {
+        a0[dd] = wrap_sub(a0[dd], d[0]);
+        a1[dd] = wrap_sub(a1[dd], d[1]);
+        a2[dd] = wrap_sub(a2[dd], d[2]);
+      }
+      auto p6 = Clock::now();
+      t_sub += us(p5, p6);
+    }
+    printf("phases (ms/queue): cap_pass=%.1f driver=%.1f extremes=%.1f "
+           "assign=%.1f subtract=%.1f\n",
+           t_pass / 1000, t_drv / 1000, t_ext / 1000, t_assign / 1000,
+           t_sub / 1000);
+    printf("attempts: instant=%ld subset_drain=%ld full=%ld\n", n_instant,
+           n_subset_drain, n_drain);
+  }
+  return 0;
+}
