@@ -27,6 +27,10 @@ class Event:
     name: str
     values: Dict[str, Any]
     timestamp: float = field(default_factory=time.time)
+    # trace of the scheduling request that emitted this event ("" when
+    # emitted outside any traced request): joins the event ring to
+    # GET /traces and the request log without grepping timestamps
+    trace_id: str = ""
 
 
 class EventLog:
@@ -35,10 +39,15 @@ class EventLog:
         self._lock = threading.Lock()
 
     def emit(self, name: str, **values: Any) -> None:
-        event = Event(name, values)
+        from ..tracing import current_trace_id
+
+        event = Event(name, values, trace_id=current_trace_id() or "")
         with self._lock:
             self._events.append(event)
-        logger.info("%s %s", name, values)
+        if event.trace_id:
+            logger.info("%s traceId=%s %s", name, event.trace_id, values)
+        else:
+            logger.info("%s %s", name, values)
 
     def all(self) -> List[Event]:
         with self._lock:
@@ -46,6 +55,9 @@ class EventLog:
 
     def by_name(self, name: str) -> List[Event]:
         return [e for e in self.all() if e.name == name]
+
+    def by_trace_id(self, trace_id: str) -> List[Event]:
+        return [e for e in self.all() if trace_id and e.trace_id == trace_id]
 
 
 # module-level default sink (swappable for tests)
@@ -64,8 +76,11 @@ def emit_application_scheduled(
     event_log: EventLog | None = None,
 ) -> None:
     """events.go:34-58."""
+    from ..tracing import current_trace_id
+
     (event_log or default_event_log).emit(
         APPLICATION_SCHEDULED,
+        traceId=current_trace_id() or "",
         instanceGroup=instance_group,
         sparkAppID=spark_app_id,
         podName=pod_name,

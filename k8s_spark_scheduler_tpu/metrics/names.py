@@ -51,6 +51,16 @@ ASYNC_CLIENT_REQUEST = "foundry.spark.scheduler.async.request.count"
 ASYNC_CLIENT_RETRIES = "foundry.spark.scheduler.async.request.retries.count"
 ASYNC_CLIENT_DROPPED = "foundry.spark.scheduler.async.request.dropped.count"
 
+# kernel profiling (tracing/profiling.py): per-dispatch jit compile vs
+# execute split for the solver kernels, tagged kernel= and lane=
+KERNEL_COMPILE_TIME = "foundry.spark.scheduler.tpu.kernel.compile.time"
+KERNEL_EXECUTE_TIME = "foundry.spark.scheduler.tpu.kernel.execute.time"
+KERNEL_CACHE_HITS = "foundry.spark.scheduler.tpu.kernel.cache.hit.count"
+KERNEL_CACHE_MISSES = "foundry.spark.scheduler.tpu.kernel.cache.miss.count"
+KERNEL_JIT_CACHE_SIZE = "foundry.spark.scheduler.tpu.kernel.jit.cache.size"
+# per-span duration distributions (tracing/spans.py), tagged span=
+TRACE_SPAN_TIME = "foundry.spark.scheduler.trace.span.time"
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"
@@ -61,6 +71,9 @@ TAG_LIFECYCLE = "lifecycle"
 TAG_QUEUE_INDEX = "queueIndex"
 TAG_WASTE_TYPE = "wastetype"
 TAG_ZONE = "zone"
+TAG_KERNEL = "kernel"
+TAG_LANE = "lane"
+TAG_SPAN = "span"
 
 TICK_INTERVAL_SECONDS = 30.0
 SLOW_LOG_THRESHOLD_SECONDS = 45.0

@@ -9,6 +9,7 @@ declared in :mod:`.names`; periodic reporters live in
 from __future__ import annotations
 
 import math
+import random
 import threading
 import time
 from collections import defaultdict
@@ -16,31 +17,48 @@ from typing import Dict, List, Tuple
 
 TagSet = Tuple[Tuple[str, str], ...]
 
+# seeded per-histogram for reproducible quantiles in tests; the seed is
+# fixed (not time-derived) so two runs over the same stream agree
+_RESERVOIR_SEED = 0x5EED
+
 
 def _tags(tags: Dict[str, str] | None) -> TagSet:
     return tuple(sorted((tags or {}).items()))
 
 
 class Histogram:
-    """Decaying-free simple histogram: count/sum/min/max/p50/p95/p99 over a
-    bounded reservoir."""
+    """Decaying-free simple histogram: count/sum/max/p50/p95/p99 over a
+    bounded reservoir.
 
-    __slots__ = ("values", "count", "total", "_cap")
+    Once the reservoir is full, replacement is Vitter's Algorithm R:
+    the i-th update survives with probability cap/i, giving every update
+    an equal chance of being in the sample — so quantiles estimate the
+    whole stream.  (The previous ``count % cap`` overwrite kept only an
+    arbitrary recent window, biasing quantiles toward whatever the last
+    ~cap updates happened to be.)  max is tracked exactly, not sampled.
+    """
+
+    __slots__ = ("values", "count", "total", "maximum", "_cap", "_rng")
 
     def __init__(self, cap: int = 2048):
         self.values: List[float] = []
         self.count = 0
         self.total = 0.0
+        self.maximum = 0.0
         self._cap = cap
+        self._rng = random.Random(_RESERVOIR_SEED)
 
     def update(self, v: float) -> None:
         self.count += 1
         self.total += v
+        if self.count == 1 or v > self.maximum:
+            self.maximum = v
         if len(self.values) < self._cap:
             self.values.append(v)
-        else:  # reservoir replace
-            idx = self.count % self._cap
-            self.values[idx] = v
+        else:  # Algorithm R: keep with probability cap/count
+            j = self._rng.randrange(self.count)
+            if j < self._cap:
+                self.values[j] = v
 
     def quantile(self, q: float) -> float:
         if not self.values:
@@ -56,7 +74,7 @@ class Histogram:
             "p50": self.quantile(0.5),
             "p95": self.quantile(0.95),
             "p99": self.quantile(0.99),
-            "max": max(self.values) if self.values else 0.0,
+            "max": self.maximum if self.count else 0.0,
         }
 
 
@@ -120,6 +138,21 @@ class MetricsRegistry:
                 "gauges": {self._fmt(k): v for k, v in self._gauges.items()},
                 "histograms": {
                     self._fmt(k): h.snapshot() for k, h in self._histograms.items()
+                },
+            }
+
+    def collect(self) -> dict:
+        """Structured (name, tags) → value dump for exposition formats
+        that need tags as labels, not baked into the name string
+        (metrics/prometheus.py).  Histograms include the running sum so
+        summaries can expose ``_sum``."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    k: dict(h.snapshot(), sum=h.total)
+                    for k, h in self._histograms.items()
                 },
             }
 

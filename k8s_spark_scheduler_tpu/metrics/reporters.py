@@ -88,6 +88,7 @@ class ReporterSet:
             self.report_soft_reservations,
             self.report_queue_depths,
             self.report_informer_delay,
+            self.report_jit_cache_sizes,
         ):
             try:
                 fn()
@@ -220,4 +221,21 @@ class ReporterSet:
                 names.INFLIGHT_REQUEST_COUNT,
                 float(depth),
                 {names.TAG_QUEUE_INDEX: str(i), "objectType": "demands"},
+            )
+
+    def report_jit_cache_sizes(self) -> None:
+        """Per-kernel jit compilation-cache entry counts: growth in
+        steady state = shape buckets leaking recompiles onto the
+        request path (see ops/batch_solver.compilation_cache_stats)."""
+        import sys
+
+        # never force the JAX import from a metrics tick: if no solver
+        # has run yet there is nothing to report
+        if "k8s_spark_scheduler_tpu.ops.batch_solver" not in sys.modules:
+            return
+        from ..ops.batch_solver import compilation_cache_stats
+
+        for kernel, size in compilation_cache_stats().items():
+            self.metrics.gauge(
+                names.KERNEL_JIT_CACHE_SIZE, float(size), {names.TAG_KERNEL: kernel}
             )

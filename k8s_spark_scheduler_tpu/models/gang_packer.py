@@ -134,10 +134,25 @@ class GangPacker:
         return args
 
     def solve(self, problem: ScaledProblem) -> QueueSolve:
-        """Run the compiled program.  problem.ok must be True."""
+        """Run the compiled program.  problem.ok must be True.
+
+        Profiled: compile vs execute time and cache hit/miss land in
+        the kernel metrics (tracing/profiling.py) tagged with the
+        configured backend lane."""
         if not problem.ok:
             raise ValueError("problem is not exactly tensorizable; use the host oracle")
-        return self._solve(*self.device_args(problem))
+        from ..tracing.profiling import default_profiler
+
+        lane = "mesh" if self._mesh is not None else self.config.backend
+        with default_profiler.profile(
+            "gang_packer.solve_queue",
+            lane=lane,
+            fn=self._solve if hasattr(self._solve, "_cache_size") else None,
+            shape_key=(problem.avail.shape, problem.driver.shape),
+        ) as rec:
+            out = self._solve(*self.device_args(problem))
+            rec.sync(out.avail_after)
+        return out
 
     def solve_fn(self):
         """(fn, sharding-prepared) — the raw jittable callable for

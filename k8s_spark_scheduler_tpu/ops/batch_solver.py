@@ -651,3 +651,24 @@ def solve_zones(
 
 
 solve_zones_jit = jax.jit(solve_zones)
+
+
+def compilation_cache_stats() -> dict:
+    """Entry counts of each jitted solver kernel's compilation cache —
+    the profiling hook behind the kernel cache-hit metrics
+    (tracing/profiling.py) and the periodic jit-cache gauge
+    (metrics/reporters.py).  A steadily growing count in steady state
+    means shape buckets are leaking recompiles onto the request path."""
+    out = {}
+    for name, fn in (
+        ("solve_queue", solve_queue),
+        ("solve_queue_min_frag", solve_queue_min_frag),
+        ("solve_single", solve_single),
+        ("solve_queue_single_az", solve_queue_single_az),
+        ("solve_zones", solve_zones_jit),
+    ):
+        try:
+            out[name] = fn._cache_size()
+        except Exception:
+            continue
+    return out

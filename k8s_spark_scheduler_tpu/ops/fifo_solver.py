@@ -17,6 +17,8 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import compat
+from ..tracing import spans as tracing
+from ..tracing.profiling import default_profiler
 from ..types.resources import NodeGroupSchedulingMetadata
 from .batch_adapter import (
     build_reserved,
@@ -390,99 +392,147 @@ class TpuFifoSolver:
         # differential-tested bit-identical to the device scans
         use_native = self._use_native()
 
+        shape_key = (problem.avail.shape, problem.driver.shape)
         if n_earlier > 0:
-            # whole-queue pass over the earlier drivers only
-            queue_valid = problem.app_valid.copy()
-            queue_valid[n_earlier:] = False
-            if use_native and minfrag:
-                from ..native.fifo import solve_queue_min_frag_native
+            # whole-queue pass over the earlier drivers only.  The
+            # fifo_gate span is the request's "earlier drivers fit?"
+            # phase; the kernel profiles inside it split the dispatch
+            # into jit-compile vs execute time (tracing/profiling.py).
+            with tracing.child_span(
+                "fifo_gate", {"earlierApps": n_earlier}
+            ) as gate_span:
+                queue_valid = problem.app_valid.copy()
+                queue_valid[n_earlier:] = False
+                if use_native and minfrag:
+                    from ..native.fifo import solve_queue_min_frag_native
 
-                self.last_queue_lane = "native-minfrag"
-                feasible_all, _, avail_after = solve_queue_min_frag_native(
-                    problem.avail, problem.driver_rank, problem.exec_ok,
-                    problem.driver, problem.executor, problem.count,
-                    queue_valid,
-                )
-                feasible = feasible_all[:n_earlier]
-            elif use_native:
-                from ..native.fifo import solve_queue_native
+                    self.last_queue_lane = "native-minfrag"
+                    with default_profiler.profile(
+                        "fifo_queue", lane="native-minfrag", jit=False
+                    ):
+                        feasible_all, _, avail_after = solve_queue_min_frag_native(
+                            problem.avail, problem.driver_rank, problem.exec_ok,
+                            problem.driver, problem.executor, problem.count,
+                            queue_valid,
+                        )
+                    feasible = feasible_all[:n_earlier]
+                elif use_native:
+                    from ..native.fifo import solve_queue_native
 
-                self.last_queue_lane = "native"
-                feasible_all, _, avail_after = solve_queue_native(
-                    problem.avail, problem.driver_rank, problem.exec_ok,
-                    problem.driver, problem.executor, problem.count,
-                    queue_valid, evenly=evenly,
-                )
-                feasible = feasible_all[:n_earlier]
-            else:
-                queue_args = (
-                    jnp.asarray(problem.avail),
-                    jnp.asarray(problem.driver_rank),
-                    jnp.asarray(problem.exec_ok),
-                    jnp.asarray(problem.driver),
-                    jnp.asarray(problem.executor),
-                    jnp.asarray(problem.count),
-                    jnp.asarray(queue_valid),
-                )
-                if minfrag and self._use_pallas():
-                    from .pallas_queue import pallas_solve_queue_min_frag
-
-                    self.last_queue_lane = "pallas-minfrag"
-                    feasible_dev, _, avail_after = pallas_solve_queue_min_frag(
-                        *queue_args
-                    )
-                    feasible = np.asarray(feasible_dev)[:n_earlier]
-                elif minfrag:
-                    self.last_queue_lane = "minfrag-xla"
-                    out = solve_queue_min_frag(*queue_args, with_placements=False)
-                    feasible = np.asarray(out.feasible)[:n_earlier]
-                    avail_after = out.avail_after
-                elif self._use_pallas():
-                    from .pallas_queue import pallas_solve_queue
-
-                    self.last_queue_lane = "pallas"
-                    feasible_dev, _, avail_after = pallas_solve_queue(
-                        *queue_args, evenly=evenly
-                    )
-                    feasible = np.asarray(feasible_dev)[:n_earlier]
+                    self.last_queue_lane = "native"
+                    with default_profiler.profile(
+                        "fifo_queue", lane="native", jit=False
+                    ):
+                        feasible_all, _, avail_after = solve_queue_native(
+                            problem.avail, problem.driver_rank, problem.exec_ok,
+                            problem.driver, problem.executor, problem.count,
+                            queue_valid, evenly=evenly,
+                        )
+                    feasible = feasible_all[:n_earlier]
                 else:
-                    self.last_queue_lane = "xla"
-                    out = solve_queue(*queue_args, evenly=evenly, with_placements=False)
-                    feasible = np.asarray(out.feasible)[:n_earlier]
-                    avail_after = out.avail_after
-            # an enforced (old-enough) earlier driver that doesn't fit
-            # fails the whole request (resource.go:244-253)
-            for i in range(n_earlier):
-                if not feasible[i] and not earlier_skip_allowed[i]:
-                    return FifoOutcome(supported=True, earlier_ok=False)
+                    queue_args = (
+                        jnp.asarray(problem.avail),
+                        jnp.asarray(problem.driver_rank),
+                        jnp.asarray(problem.exec_ok),
+                        jnp.asarray(problem.driver),
+                        jnp.asarray(problem.executor),
+                        jnp.asarray(problem.count),
+                        jnp.asarray(queue_valid),
+                    )
+                    if minfrag and self._use_pallas():
+                        from .pallas_queue import pallas_solve_queue_min_frag
+
+                        self.last_queue_lane = "pallas-minfrag"
+                        with default_profiler.profile(
+                            "fifo_queue", lane="pallas-minfrag",
+                            shape_key=shape_key,
+                        ) as rec:
+                            feasible_dev, _, avail_after = pallas_solve_queue_min_frag(
+                                *queue_args
+                            )
+                            rec.sync(avail_after)
+                        feasible = np.asarray(feasible_dev)[:n_earlier]
+                    elif minfrag:
+                        self.last_queue_lane = "minfrag-xla"
+                        with default_profiler.profile(
+                            "fifo_queue", lane="minfrag-xla",
+                            fn=solve_queue_min_frag,
+                        ) as rec:
+                            out = solve_queue_min_frag(*queue_args, with_placements=False)
+                            rec.sync(out.avail_after)
+                        feasible = np.asarray(out.feasible)[:n_earlier]
+                        avail_after = out.avail_after
+                    elif self._use_pallas():
+                        from .pallas_queue import pallas_solve_queue
+
+                        self.last_queue_lane = "pallas"
+                        with default_profiler.profile(
+                            "fifo_queue", lane="pallas", shape_key=shape_key
+                        ) as rec:
+                            feasible_dev, _, avail_after = pallas_solve_queue(
+                                *queue_args, evenly=evenly
+                            )
+                            rec.sync(avail_after)
+                        feasible = np.asarray(feasible_dev)[:n_earlier]
+                    else:
+                        self.last_queue_lane = "xla"
+                        with default_profiler.profile(
+                            "fifo_queue", lane="xla", fn=solve_queue
+                        ) as rec:
+                            out = solve_queue(*queue_args, evenly=evenly, with_placements=False)
+                            rec.sync(out.avail_after)
+                        feasible = np.asarray(out.feasible)[:n_earlier]
+                        avail_after = out.avail_after
+                gate_span.tag("lane", self.last_queue_lane)
+                # an enforced (old-enough) earlier driver that doesn't fit
+                # fails the whole request (resource.go:244-253)
+                for i in range(n_earlier):
+                    if not feasible[i] and not earlier_skip_allowed[i]:
+                        gate_span.tag("earlierOk", False)
+                        return FifoOutcome(supported=True, earlier_ok=False)
+                gate_span.tag("earlierOk", True)
         else:
-            avail_after = problem.avail if use_native else jnp.asarray(problem.avail)
+            with tracing.child_span("fifo_gate", {"earlierApps": 0, "earlierOk": True}):
+                avail_after = problem.avail if use_native else jnp.asarray(problem.avail)
 
-        if use_native:
-            from ..native.fifo import solve_app_native
+        with tracing.child_span(
+            "binpack", {"policy": self.assignment_policy}
+        ) as binpack_span:
+            if use_native:
+                from ..native.fifo import solve_app_native
 
-            nat_feas, nat_didx, nat_counts, nat_caps = solve_app_native(
-                np.asarray(avail_after), problem.driver_rank, problem.exec_ok,
-                problem.driver[n_earlier], problem.executor[n_earlier],
-                int(problem.count[n_earlier]),
-            )
-            from .batch_solver import AppSolve
+                binpack_span.tag("lane", "native")
+                with default_profiler.profile(
+                    "solve_app", lane="native", jit=False
+                ):
+                    nat_feas, nat_didx, nat_counts, nat_caps = solve_app_native(
+                        np.asarray(avail_after), problem.driver_rank, problem.exec_ok,
+                        problem.driver[n_earlier], problem.executor[n_earlier],
+                        int(problem.count[n_earlier]),
+                    )
+                from .batch_solver import AppSolve
 
-            solve = AppSolve(
-                feasible=np.bool_(nat_feas),
-                driver_idx=np.int32(nat_didx),
-                exec_counts=nat_counts,
-                exec_capacity=nat_caps,
-            )
-        else:
-            solve = solve_single(
-                avail_after,
-                jnp.asarray(problem.driver_rank),
-                jnp.asarray(problem.exec_ok),
-                jnp.asarray(problem.driver[n_earlier]),
-                jnp.asarray(problem.executor[n_earlier]),
-                jnp.asarray(problem.count[n_earlier]),
-            )
+                solve = AppSolve(
+                    feasible=np.bool_(nat_feas),
+                    driver_idx=np.int32(nat_didx),
+                    exec_counts=nat_counts,
+                    exec_capacity=nat_caps,
+                )
+            else:
+                binpack_span.tag("lane", "xla")
+                with default_profiler.profile(
+                    "solve_single", lane="xla", fn=solve_single
+                ) as rec:
+                    solve = solve_single(
+                        avail_after,
+                        jnp.asarray(problem.driver_rank),
+                        jnp.asarray(problem.exec_ok),
+                        jnp.asarray(problem.driver[n_earlier]),
+                        jnp.asarray(problem.executor[n_earlier]),
+                        jnp.asarray(problem.count[n_earlier]),
+                    )
+                    rec.sync(solve.exec_counts)
+            binpack_span.tag("feasible", bool(solve.feasible))
         if not bool(solve.feasible):
             return FifoOutcome(supported=True, earlier_ok=True, result=empty_packing_result())
 
@@ -817,19 +867,27 @@ class TpuSingleAzFifoSolver:
             # lane with no uncertainty valve, at native speed
             from ..native.fifo import solve_queue_single_az_native
 
-            feas_n, _zone_n, _didx_n, avail_after_n = solve_queue_single_az_native(
-                avail, problem.driver_rank, np.asarray(problem.exec_ok),
-                zone_vec, problem.driver, problem.executor, problem.count,
-                queue_valid, cluster.sched, scale,
-                n_zones=len(candidate_zones), az_aware=self.az_aware,
-                minfrag=minfrag_inner, strict=self.strict_reference_parity,
-            )
-            self.last_path = "native"
-            for i in range(n_earlier):
-                if not feas_n[i] and not earlier_skip_allowed[i]:
-                    return FifoOutcome(supported=True, earlier_ok=False)
-            avail[:] = avail_after_n
-            fused_done = True
+            with tracing.child_span(
+                "fifo_gate", {"lane": "native", "earlierApps": n_earlier}
+            ) as gate_span:
+                with default_profiler.profile(
+                    "fifo_queue_single_az", lane="native", jit=False
+                ):
+                    feas_n, _zone_n, _didx_n, avail_after_n = solve_queue_single_az_native(
+                        avail, problem.driver_rank, np.asarray(problem.exec_ok),
+                        zone_vec, problem.driver, problem.executor, problem.count,
+                        queue_valid, cluster.sched, scale,
+                        n_zones=len(candidate_zones), az_aware=self.az_aware,
+                        minfrag=minfrag_inner, strict=self.strict_reference_parity,
+                    )
+                self.last_path = "native"
+                for i in range(n_earlier):
+                    if not feas_n[i] and not earlier_skip_allowed[i]:
+                        gate_span.tag("earlierOk", False)
+                        return FifoOutcome(supported=True, earlier_ok=False)
+                gate_span.tag("earlierOk", True)
+                avail[:] = avail_after_n
+                fused_done = True
 
         if not fused_done and n_earlier > 0 and mf_fused_ok:
             eff_inputs = _fused_efficiency_inputs(cluster, problem)
@@ -840,29 +898,34 @@ class TpuSingleAzFifoSolver:
 
                     from .batch_solver import ZoneQueueSolve
 
-                    feas_d, zone_d, didx_d, uncertain_d, avail_after_d = (
-                        pallas_solve_queue_single_az(
-                            jnp.asarray(avail),
-                            rank_dev,
-                            exec_dev,
-                            jnp.asarray(zone_vec),
-                            jnp.asarray(problem.driver),
-                            jnp.asarray(problem.executor),
-                            jnp.asarray(problem.count),
-                            jnp.asarray(queue_valid),
-                            jnp.asarray(s_cpu),
-                            jnp.asarray(s_gpu),
-                            jnp.asarray(inv_m),
-                            jnp.asarray(th_m),
-                            jnp.asarray(np.array([scale_c], np.int32)),
-                            jnp.asarray(np.array([scale_g], np.int32)),
-                            n_zones=len(candidate_zones),
-                            az_aware=self.az_aware,
-                            interpret=self.interpret,
-                            minfrag=minfrag_inner,
-                            strict=self.strict_reference_parity,
+                    with default_profiler.profile(
+                        "fifo_queue_single_az", lane="pallas",
+                        shape_key=(avail.shape, problem.driver.shape),
+                    ) as rec:
+                        feas_d, zone_d, didx_d, uncertain_d, avail_after_d = (
+                            pallas_solve_queue_single_az(
+                                jnp.asarray(avail),
+                                rank_dev,
+                                exec_dev,
+                                jnp.asarray(zone_vec),
+                                jnp.asarray(problem.driver),
+                                jnp.asarray(problem.executor),
+                                jnp.asarray(problem.count),
+                                jnp.asarray(queue_valid),
+                                jnp.asarray(s_cpu),
+                                jnp.asarray(s_gpu),
+                                jnp.asarray(inv_m),
+                                jnp.asarray(th_m),
+                                jnp.asarray(np.array([scale_c], np.int32)),
+                                jnp.asarray(np.array([scale_g], np.int32)),
+                                n_zones=len(candidate_zones),
+                                az_aware=self.az_aware,
+                                interpret=self.interpret,
+                                minfrag=minfrag_inner,
+                                strict=self.strict_reference_parity,
+                            )
                         )
-                    )
+                        rec.sync(avail_after_d)
                     out = ZoneQueueSolve(
                         feasible=feas_d,
                         zone_idx=zone_d,
@@ -871,34 +934,44 @@ class TpuSingleAzFifoSolver:
                         avail_after=avail_after_d,
                     )
                 else:
-                    out = solve_queue_single_az(
-                        jnp.asarray(avail),
-                        rank_dev,
-                        exec_dev,
-                        zone_masks_dev,
-                        jnp.asarray(problem.driver),
-                        jnp.asarray(problem.executor),
-                        jnp.asarray(problem.count),
-                        jnp.asarray(queue_valid),
-                        jnp.asarray(s_cpu),
-                        jnp.asarray(s_gpu),
-                        jnp.asarray(inv_m),
-                        jnp.asarray(th_m),
-                        jnp.int32(scale_c),
-                        jnp.int32(scale_g),
-                        az_aware=self.az_aware,
-                        minfrag=minfrag_inner,
-                        strict=self.strict_reference_parity,
-                    )
+                    with default_profiler.profile(
+                        "fifo_queue_single_az", lane="xla",
+                        fn=solve_queue_single_az,
+                    ) as rec:
+                        out = solve_queue_single_az(
+                            jnp.asarray(avail),
+                            rank_dev,
+                            exec_dev,
+                            zone_masks_dev,
+                            jnp.asarray(problem.driver),
+                            jnp.asarray(problem.executor),
+                            jnp.asarray(problem.count),
+                            jnp.asarray(queue_valid),
+                            jnp.asarray(s_cpu),
+                            jnp.asarray(s_gpu),
+                            jnp.asarray(inv_m),
+                            jnp.asarray(th_m),
+                            jnp.int32(scale_c),
+                            jnp.int32(scale_g),
+                            az_aware=self.az_aware,
+                            minfrag=minfrag_inner,
+                            strict=self.strict_reference_parity,
+                        )
+                        rec.sync(out.avail_after)
                 if not bool(np.asarray(out.uncertain)[:n_earlier].any()):
                     # the one-dispatch lane's answer is certain — it is
                     # the lane that served this request, whatever the
                     # FIFO verdict
                     self.last_path = "fused"
                     feasible = np.asarray(out.feasible)[:n_earlier]
-                    for i in range(n_earlier):
-                        if not feasible[i] and not earlier_skip_allowed[i]:
-                            return FifoOutcome(supported=True, earlier_ok=False)
+                    with tracing.child_span(
+                        "fifo_gate", {"lane": "fused", "earlierApps": n_earlier}
+                    ) as gate_span:
+                        for i in range(n_earlier):
+                            if not feasible[i] and not earlier_skip_allowed[i]:
+                                gate_span.tag("earlierOk", False)
+                                return FifoOutcome(supported=True, earlier_ok=False)
+                        gate_span.tag("earlierOk", True)
                     # keep the closure binding: copy the carried result
                     # into the same array pack_one reads
                     avail[:] = np.asarray(out.avail_after)
@@ -908,22 +981,31 @@ class TpuSingleAzFifoSolver:
             # host lane: per-driver vmapped zone solves with the exact
             # float64 zone choice (the uncertainty/guard fallback)
             self.last_path = "host"
-            for i, app in enumerate(earlier_apps):
-                packed = pack_one(i)
-                if packed is None and self.az_aware:
-                    fallback = plain_fallback(i)
-                    packed = fallback if fallback is None else (*fallback, None)
-                if packed is None:
-                    if earlier_skip_allowed[i]:
-                        continue
-                    return FifoOutcome(supported=True, earlier_ok=False)
-                d_idx, counts = packed[0], packed[1]
-                self._subtract(avail, d_idx, counts, problem, i, n)
+            with tracing.child_span(
+                "fifo_gate", {"lane": "host", "earlierApps": n_earlier}
+            ) as gate_span:
+                for i, app in enumerate(earlier_apps):
+                    packed = pack_one(i)
+                    if packed is None and self.az_aware:
+                        fallback = plain_fallback(i)
+                        packed = fallback if fallback is None else (*fallback, None)
+                    if packed is None:
+                        if earlier_skip_allowed[i]:
+                            continue
+                        gate_span.tag("earlierOk", False)
+                        return FifoOutcome(supported=True, earlier_ok=False)
+                    d_idx, counts = packed[0], packed[1]
+                    self._subtract(avail, d_idx, counts, problem, i, n)
+                gate_span.tag("earlierOk", True)
 
-        packed = pack_one(len(earlier_apps))
-        if packed is None and self.az_aware:
-            fallback = plain_fallback(len(earlier_apps))
-            packed = fallback if fallback is None else (*fallback, None)
+        with tracing.child_span(
+            "binpack", {"policy": self.inner_policy, "azAware": self.az_aware}
+        ) as bp_span:
+            packed = pack_one(len(earlier_apps))
+            if packed is None and self.az_aware:
+                fallback = plain_fallback(len(earlier_apps))
+                packed = fallback if fallback is None else (*fallback, None)
+            bp_span.tag("feasible", packed is not None)
         if packed is None:
             return FifoOutcome(supported=True, earlier_ok=True, result=empty_packing_result())
         d_idx, counts, chosen = packed

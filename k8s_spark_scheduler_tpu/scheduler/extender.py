@@ -20,6 +20,7 @@ import numpy as np
 
 from .. import compat
 from ..config import FifoConfig
+from ..tracing import spans as tracing
 from ..demands.manager import DemandManager
 from ..events import events as ev
 from ..kube.informer import Informer
@@ -100,6 +101,7 @@ class SparkSchedulerExtender:
         waste_reporter=None,
         tensor_snapshot_cache=None,
         strict_reference_parity: bool = compat.DEFAULT_STRICT,
+        tracer: Optional[tracing.Tracer] = None,
     ):
         self._node_informer = node_informer
         self._pod_lister = pod_lister
@@ -116,6 +118,7 @@ class SparkSchedulerExtender:
         self._node_sorter = node_sorter
         self._metrics = metrics or default_registry
         self._event_log = event_log
+        self._tracer = tracer if tracer is not None else tracing.default_tracer
         self._waste_reporter = waste_reporter
         # event-driven integer snapshot for the driver fast path; the
         # fast lexsort replicates the NodeSorter ordering including any
@@ -137,7 +140,14 @@ class SparkSchedulerExtender:
     def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
         """resource.go:128-183."""
         with self._predicate_lock:
-            return self._predicate_locked(args)
+            # one span per scheduling decision; role/instanceGroup/
+            # outcome/node tags land via add_tag as they are computed.
+            # Becomes the trace root when called outside the HTTP layer.
+            with self._tracer.span(
+                "predicate",
+                {"pod": args.pod.name, "namespace": args.pod.namespace},
+            ):
+                return self._predicate_locked(args)
 
     def _predicate_locked(self, args: ExtenderArgs) -> ExtenderFilterResult:
         pod = args.pod
@@ -186,6 +196,7 @@ class SparkSchedulerExtender:
             return self._fail_with_message(err.outcome, args, str(err))
 
         self._mark_schedule(instance_group, role, outcome, t0, pod)
+        tracing.add_tag("node", node_name)
 
         if role == L.DRIVER:
             try:
@@ -217,6 +228,9 @@ class SparkSchedulerExtender:
         first-sight slow log fires only on first tries."""
         from ..metrics import names as mnames
 
+        tracing.add_tag("role", role)
+        tracing.add_tag("instanceGroup", instance_group)
+        tracing.add_tag("outcome", outcome)
         tags = {"instanceGroup": instance_group, "role": role, "outcome": outcome}
         self._metrics.histogram(mnames.SCHEDULING_PROCESSING_TIME, time.perf_counter() - t0, tags)
         self._metrics.counter(mnames.REQUEST_COUNTER, tags)
@@ -260,7 +274,8 @@ class SparkSchedulerExtender:
             from .failover import sync_resource_reservations_and_demands
 
             t0 = time.perf_counter()
-            sync_resource_reservations_and_demands(self)
+            with self._tracer.span("reconcile"):
+                sync_resource_reservations_and_demands(self)
             self._metrics.histogram(
                 mnames.RECONCILIATION_TIME, time.perf_counter() - t0
             )
@@ -366,14 +381,18 @@ class SparkSchedulerExtender:
                 )
 
         if packing_result is None:
-            packing_result = self.binpacker.binpack_func(
-                app_resources.driver_resources,
-                app_resources.executor_resources,
-                app_resources.min_executor_count,
-                driver_node_names,
-                executor_node_names,
-                metadata,
-            )
+            with self._tracer.span(
+                "binpack", {"policy": self.binpacker.name, "lane": "host"}
+            ) as sp:
+                packing_result = self.binpacker.binpack_func(
+                    app_resources.driver_resources,
+                    app_resources.executor_resources,
+                    app_resources.min_executor_count,
+                    driver_node_names,
+                    executor_node_names,
+                    metadata,
+                )
+                sp.tag("hasCapacity", packing_result.has_capacity)
         efficiency = compute_avg_packing_efficiency(
             metadata, list(packing_result.packing_efficiencies.values())
         ) if packing_result.has_capacity else None
@@ -442,13 +461,15 @@ class SparkSchedulerExtender:
             from ..ops.sparkapp import AppDemand
 
             snap = self._tensor_snapshot.snapshot()
-            built = build_cluster_tensor(
-                snap,
-                driver,
-                list(node_names),
-                driver_label_priority=self._node_sorter.driver_label_priority,
-                executor_label_priority=self._node_sorter.executor_label_priority,
-            )
+            with self._tracer.span("fast_path.build_tensor") as sp:
+                built = build_cluster_tensor(
+                    snap,
+                    driver,
+                    list(node_names),
+                    driver_label_priority=self._node_sorter.driver_label_priority,
+                    executor_label_priority=self._node_sorter.executor_label_priority,
+                )
+                sp.tag("exact", built is not None)
             if built is None:
                 return None
             cluster, zones = built
@@ -553,38 +574,43 @@ class SparkSchedulerExtender:
     ) -> bool:
         """resource.go:224-262: binpack every earlier driver and subtract
         its usage before considering this one."""
-        for driver in drivers:
-            try:
-                app_resources = spark_resources_cached(driver)
-            except AnnotationError:
-                logger.warning("failed to get driver resources, skipping driver %s", driver.name)
-                continue
-            packing_result = self.binpacker.binpack_func(
-                app_resources.driver_resources,
-                app_resources.executor_resources,
-                app_resources.min_executor_count,
-                node_names,
-                executor_node_names,
-                metadata,
-            )
-            if not packing_result.has_capacity:
-                if self._should_skip_driver_fifo(driver, instance_group):
-                    logger.debug(
-                        "skipping non-fitting driver %s from FIFO: not old enough", driver.name
-                    )
+        with self._tracer.span(
+            "fifo_gate", {"lane": "host", "earlierApps": len(drivers)}
+        ) as sp:
+            for driver in drivers:
+                try:
+                    app_resources = spark_resources_cached(driver)
+                except AnnotationError:
+                    logger.warning("failed to get driver resources, skipping driver %s", driver.name)
                     continue
-                logger.warning("failed to fit earlier driver %s", driver.name)
-                return False
-            subtract_usage_if_exists(
-                metadata,
-                spark_resource_usage(
+                packing_result = self.binpacker.binpack_func(
                     app_resources.driver_resources,
                     app_resources.executor_resources,
-                    packing_result.driver_node,
-                    packing_result.executor_nodes,
-                ),
-            )
-        return True
+                    app_resources.min_executor_count,
+                    node_names,
+                    executor_node_names,
+                    metadata,
+                )
+                if not packing_result.has_capacity:
+                    if self._should_skip_driver_fifo(driver, instance_group):
+                        logger.debug(
+                            "skipping non-fitting driver %s from FIFO: not old enough", driver.name
+                        )
+                        continue
+                    logger.warning("failed to fit earlier driver %s", driver.name)
+                    sp.tag("earlierOk", False).tag("blockedBy", driver.name)
+                    return False
+                subtract_usage_if_exists(
+                    metadata,
+                    spark_resource_usage(
+                        app_resources.driver_resources,
+                        app_resources.executor_resources,
+                        packing_result.driver_node,
+                        packing_result.executor_nodes,
+                    ),
+                )
+            sp.tag("earlierOk", True)
+            return True
 
     def _should_skip_driver_fifo(self, pod: Pod, instance_group: str) -> bool:
         """resource.go:264-270."""
@@ -817,46 +843,56 @@ class SparkSchedulerExtender:
         if self._tensor_snapshot is None or not self._fast_path_ok:
             return None
         try:
-            from ..ops.fast_path import executor_reschedule_order
-            from ..ops.tensorize import _resources_to_base
-
-            snap = self._tensor_snapshot.snapshot()
-            exec_row, exact = _resources_to_base(executor_resources)
-            if not exact:
-                return None
-            built = executor_reschedule_order(
-                snap,
-                list(node_names),
-                self._node_sorter.executor_label_priority,
-                zone,
-            )
-            if built is None:
-                return None
-            names, avail, overhead, res_entry = built
-            row = np.array(exec_row, dtype=np.int64)
-            if self._is_single_az_min_frag():
-                hit_name = self._fast_min_frag_reschedule(
-                    executor, names, avail, overhead, row
+            with self._tracer.span("executor.fast_reschedule") as span:
+                return self._try_fast_reschedule_traced(
+                    executor, node_names, executor_resources, zone, span
                 )
-                self.last_reschedule_path = "fast"
-                if hit_name is not None:
-                    return True, hit_name
-                return False, None
-            fit_avail = avail
-            if self._strict_reference_parity and len(names):
-                # QUIRK #1 (resource.go:638-643): nodes with a usage
-                # entry see overhead subtracted twice on this path
-                fit_avail = avail.copy()
-                fit_avail[res_entry] -= overhead[res_entry]
-            fits = (fit_avail >= row[None, :]).all(axis=1)
-            hit = np.flatnonzero(fits)
-            self.last_reschedule_path = "fast"
-            if len(hit):
-                return True, names[int(hit[0])]
-            return False, None
         except Exception:
             logger.exception("fast reschedule lane failed; using Quantity path")
             return None
+
+    def _try_fast_reschedule_traced(
+        self, executor, node_names, executor_resources, zone, span
+    ):
+        from ..ops.fast_path import executor_reschedule_order
+        from ..ops.tensorize import _resources_to_base
+
+        snap = self._tensor_snapshot.snapshot()
+        exec_row, exact = _resources_to_base(executor_resources)
+        if not exact:
+            return None
+        built = executor_reschedule_order(
+            snap,
+            list(node_names),
+            self._node_sorter.executor_label_priority,
+            zone,
+        )
+        if built is None:
+            return None
+        names, avail, overhead, res_entry = built
+        row = np.array(exec_row, dtype=np.int64)
+        if self._is_single_az_min_frag():
+            hit_name = self._fast_min_frag_reschedule(
+                executor, names, avail, overhead, row
+            )
+            self.last_reschedule_path = "fast"
+            span.tag("hit", hit_name is not None)
+            if hit_name is not None:
+                return True, hit_name
+            return False, None
+        fit_avail = avail
+        if self._strict_reference_parity and len(names):
+            # QUIRK #1 (resource.go:638-643): nodes with a usage
+            # entry see overhead subtracted twice on this path
+            fit_avail = avail.copy()
+            fit_avail[res_entry] -= overhead[res_entry]
+        fits = (fit_avail >= row[None, :]).all(axis=1)
+        hit = np.flatnonzero(fits)
+        self.last_reschedule_path = "fast"
+        span.tag("hit", bool(len(hit)))
+        if len(hit):
+            return True, names[int(hit[0])]
+        return False, None
 
     def _fast_min_frag_reschedule(self, executor, names, avail, overhead, row):
         """resource.go:675-703 from the mirror: capacity per node with

@@ -78,12 +78,15 @@ class ResourceReservationManager:
         pod_lister: SparkPodLister,
         pod_informer: Informer,
         metrics=None,
+        tracer=None,
     ):
         from ..metrics.registry import default_registry
+        from ..tracing import default_tracer
 
         self._resource_reservations = resource_reservations
         self._soft_reservations = soft_reservation_store
         self._metrics = metrics if metrics is not None else default_registry
+        self._tracer = tracer if tracer is not None else default_tracer
         self._pod_lister = pod_lister
         self._mutex = threading.RLock()
         self._da_compaction_apps: Dict[str, str] = {}  # appID → namespace
@@ -133,21 +136,36 @@ class ResourceReservationManager:
     ) -> ResourceReservation:
         """resourcereservations.go:136-159."""
         app_id = driver.labels.get(L.SPARK_APP_ID_LABEL, "")
-        rr = self.get_resource_reservation(app_id, driver.namespace)
-        if rr is None:
-            rr = new_resource_reservation(
-                driver_node,
-                executor_nodes,
-                driver,
-                application_resources.driver_resources,
-                application_resources.executor_resources,
-            )
-            self._resource_reservations.create(rr)
+        with self._tracer.span(
+            "reservation.writeback",
+            {"app": app_id, "executors": len(executor_nodes)},
+        ) as sp:
+            rr = self.get_resource_reservation(app_id, driver.namespace)
+            sp.tag("replay", rr is not None)
+            if rr is None:
+                rr = new_resource_reservation(
+                    driver_node,
+                    executor_nodes,
+                    driver,
+                    application_resources.driver_resources,
+                    application_resources.executor_resources,
+                )
+                self._resource_reservations.create(rr)
+                # the async write-back queue drains to the API server;
+                # its depth at enqueue time is the staleness signal for
+                # a slow write-back investigation
+                try:
+                    sp.tag(
+                        "writeQueueDepth",
+                        sum(self._resource_reservations.inflight_queue_lengths()),
+                    )
+                except Exception:
+                    pass
 
-        if application_resources.max_executor_count > application_resources.min_executor_count:
-            # only DA apps can request extra executors
-            self._soft_reservations.create_soft_reservation_if_not_exists(app_id)
-        return rr
+            if application_resources.max_executor_count > application_resources.min_executor_count:
+                # only DA apps can request extra executors
+                self._soft_reservations.create_soft_reservation_if_not_exists(app_id)
+            return rr
 
     # -- executor binding ----------------------------------------------------
 

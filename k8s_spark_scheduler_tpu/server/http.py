@@ -7,23 +7,43 @@
   standalone, mirroring the spark-scheduler-conversion-webhook module)
 - ``GET /status/liveness`` / ``GET /status/readiness`` — management
   probes (witchcraft server equivalents, examples/extender.yml:142-151)
-- ``GET /metrics`` — metrics registry snapshot (JSON)
+- ``GET /metrics`` — metrics registry snapshot: JSON by default,
+  Prometheus text exposition when the Accept header asks for
+  ``text/plain``/openmetrics or ``?format=prometheus`` is passed
+- ``GET /traces`` — recent completed span trees (tracing/spans.py ring)
+- ``GET /debug/schedule/<pod>`` — human-readable explanation of the
+  last scheduling decision for a pod: span tree + correlated events
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import re
 import threading
 import time
-import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, unquote, urlsplit
 
+from ..tracing import spans as tracing
 from ..types import serde
 from .wiring import Server
 
 logger = logging.getLogger(__name__)
+
+# inbound X-Trace-Id must be propagation-safe before it is echoed into
+# response headers and log lines: bounded length, trace-id charset only
+# (hex/alnum plus the separators zipkin-style ids use).  Anything else —
+# control characters, log-injection payloads, unbounded blobs — is
+# replaced with a fresh id.
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+
+def sanitize_trace_id(raw: Optional[str]) -> str:
+    if raw and _TRACE_ID_RE.match(raw):
+        return raw
+    return tracing.new_trace_id()
 
 
 class _ExtenderHTTPD(ThreadingHTTPServer):
@@ -69,10 +89,9 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug("http: " + fmt, *args)
 
-    def _send_json(self, code: int, payload: dict) -> None:
-        data = json.dumps(payload).encode()
+    def _send_bytes(self, code: int, data: bytes, content_type: str) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         trace = getattr(self, "_trace", None)
         if trace is not None:
@@ -85,19 +104,48 @@ class _Handler(BaseHTTPRequestHandler):
                 code,
                 (time.perf_counter() - t0) * 1000.0,
             )
+            span = tracing.current_span()
+            if span is not None:
+                span.tag("status", code)
+        # close the root span BEFORE the response bytes go out: a client
+        # that sees the response must be able to retrieve the trace from
+        # /traces immediately (the do_* finally is only a backstop for
+        # handlers that die before responding)
+        self._finish_trace()
         self.end_headers()
         self.wfile.write(data)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send_bytes(code, json.dumps(payload).encode(), "application/json")
+
+    def _send_text(self, code: int, text: str, content_type: str = "text/plain; charset=utf-8") -> None:
+        self._send_bytes(code, text.encode(), content_type)
 
     def _read_json(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    def _tracer(self):
+        return self.scheduler.tracer if self.scheduler is not None else None
+
     def do_GET(self):
-        self._begin_trace()
-        if self.path == "/status/liveness":
+        # GET endpoints (probes, /metrics scrapes, /traces polls) keep
+        # the trace-id header + request log line but do NOT open a root
+        # span: recording them would churn scheduling decisions out of
+        # the bounded trace ring (2 probes/10s evict a predicate trace
+        # from a 256-ring in minutes on an idle scheduler)
+        self._begin_trace(open_span=False)
+        try:
+            self._handle_get()
+        finally:
+            self._finish_trace()
+
+    def _handle_get(self):
+        path, query = self._split_path()
+        if path == "/status/liveness":
             self._send_json(200, {"status": "up"})
-        elif self.path == "/status/readiness":
+        elif path == "/status/readiness":
             ready = self.webhook_only or (
                 self.scheduler is not None
                 and self.scheduler.informer_factory.wait_for_cache_sync()
@@ -107,20 +155,94 @@ class _Handler(BaseHTTPRequestHandler):
                 and self.scheduler.warmup_complete()
             )
             self._send_json(200 if ready else 503, {"ready": ready})
-        elif self.path == "/metrics" and self.scheduler is not None:
-            self._send_json(200, self.scheduler.metrics.snapshot())
+        elif path == "/metrics" and self.scheduler is not None:
+            if self._wants_prometheus(query):
+                from ..metrics import prometheus as prom
+
+                self._send_text(
+                    200, prom.render(self.scheduler.metrics), prom.CONTENT_TYPE
+                )
+            else:
+                self._send_json(200, self.scheduler.metrics.snapshot())
+        elif path == "/traces" and self.scheduler is not None:
+            tracer = self._tracer()
+            if tracer is None:
+                self._send_json(404, {"error": "tracing not enabled"})
+                return
+            limit = None
+            try:
+                limit = int(query.get("limit", [""])[0])
+            except (ValueError, IndexError):
+                pass
+            self._send_json(200, {"traces": tracer.traces(limit=limit)})
+        elif path.startswith("/debug/schedule/") and self.scheduler is not None:
+            self._handle_debug_schedule(unquote(path[len("/debug/schedule/"):]))
         else:
             self._send_json(404, {"error": "not found"})
 
-    def _begin_trace(self):
+    def _split_path(self):
+        parts = urlsplit(self.path)
+        return parts.path, parse_qs(parts.query)
+
+    def _wants_prometheus(self, query) -> bool:
+        fmt = query.get("format", [""])[0] if query.get("format") else ""
+        if fmt:
+            return fmt in ("prometheus", "text")
+        accept = self.headers.get("Accept") or ""
+        return "text/plain" in accept or "openmetrics" in accept
+
+    def _handle_debug_schedule(self, pod_name: str) -> None:
+        """Explain the last scheduling decision for a pod: the newest
+        trace tagged pod=<name> rendered as a text span tree, with the
+        event-ring records of the same trace appended."""
+        tracer = self._tracer()
+        if tracer is None or not pod_name:
+            self._send_json(404, {"error": "tracing not enabled"})
+            return
+        trace = tracer.find_by_tag("pod", pod_name)
+        if trace is None:
+            self._send_text(
+                404,
+                f"no recorded scheduling decision for pod {pod_name!r} "
+                f"(ring holds {len(tracer)} traces)\n",
+            )
+            return
+        events = [
+            (e.name, e.values)
+            for e in self.scheduler.event_log.by_trace_id(trace["traceId"])
+        ]
+        self._send_text(200, tracing.render_trace_text(trace, events))
+
+    def _begin_trace(self, open_span: bool = True):
         # request tracing (the reference's witchcraft request log / trc1
         # analog): a trace id per request, echoed in the response header
-        # and the request log line with the handler duration
-        trace_id = self.headers.get("X-Trace-Id") or uuid.uuid4().hex[:16]
+        # and the request log line with the handler duration.  The
+        # inbound header is sanitized before it can reach a header or
+        # log line; the root span carries the whole handler.
+        trace_id = sanitize_trace_id(self.headers.get("X-Trace-Id"))
         self._trace = (trace_id, time.perf_counter())
+        tracer = self._tracer()
+        self._root_span = None
+        if open_span and tracer is not None and tracer.enabled:
+            self._root_span = tracer.span(
+                "http.request", {"path": self.path}, trace_id=trace_id
+            )
+            self._root_span.__enter__()
+
+    def _finish_trace(self):
+        span = getattr(self, "_root_span", None)
+        if span is not None:
+            span.__exit__(None, None, None)
+            self._root_span = None
 
     def do_POST(self):
         self._begin_trace()
+        try:
+            self._handle_post()
+        finally:
+            self._finish_trace()
+
+    def _handle_post(self):
         try:
             body = self._read_json()
         except (ValueError, json.JSONDecodeError) as err:

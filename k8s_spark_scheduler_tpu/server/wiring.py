@@ -33,6 +33,8 @@ from ..state.typed_caches import (
     ResourceReservationCache,
     SafeDemandCache,
 )
+from ..tracing import Tracer
+from ..tracing import profiling as kernel_profiling
 from ..types.objects import Demand, Node, Pod, ResourceReservation
 
 
@@ -59,6 +61,7 @@ class Server:
     unschedulable_marker: UnschedulablePodMarker
     metrics: MetricsRegistry
     event_log: EventLog
+    tracer: Tracer = None
     reporters: "ReporterSet" = None
     waste_reporter: "WasteMetricsReporter" = None
 
@@ -315,6 +318,12 @@ def init_server_with_clients(
     """cmd/server.go:65-237, bottom-up."""
     metrics = MetricsRegistry()
     event_log = EventLog()
+    # request tracing + kernel profiling sinks.  The profiler is a
+    # module-level singleton (solvers are built without wiring access);
+    # rebinding it here points kernel metrics/spans at THIS server —
+    # correct for the one-server-per-process production shape.
+    tracer = Tracer(capacity=256, metrics=metrics)
+    kernel_profiling.default_profiler.configure(metrics=metrics, tracer=tracer)
 
     # CRD ensure (cmd/server.go:83-85)
     crd.ensure_resource_reservations_crd(
@@ -358,7 +367,9 @@ def init_server_with_clients(
     # stores + managers (cmd/server.go:157-167)
     soft_store = SoftReservationStore(pod_informer)
     pod_lister = SparkPodLister(pod_informer, install.instance_group_label)
-    rrm = ResourceReservationManager(rr_cache, soft_store, pod_lister, pod_informer, metrics=metrics)
+    rrm = ResourceReservationManager(
+        rr_cache, soft_store, pod_lister, pod_informer, metrics=metrics, tracer=tracer
+    )
     overhead = OverheadComputer(pod_informer, rrm)
 
     # event-driven integer snapshot for the tpu-batch fast path
@@ -393,6 +404,7 @@ def init_server_with_clients(
         waste_reporter=waste_reporter,
         tensor_snapshot_cache=tensor_snapshot,
         strict_reference_parity=install.strict_reference_parity,
+        tracer=tracer,
     )
     marker = UnschedulablePodMarker(
         api,
@@ -424,6 +436,7 @@ def init_server_with_clients(
         unschedulable_marker=marker,
         metrics=metrics,
         event_log=event_log,
+        tracer=tracer,
         waste_reporter=waste_reporter,
     )
     server.reporters = ReporterSet(server)

@@ -20,6 +20,7 @@ logger = logging.getLogger(__name__)
 from ..kube import errors as kerrors
 from ..kube.apiserver import APIServer
 from ..kube.informer import Informer
+from ..tracing import spans as tracing
 from ..types.objects import APIObject
 from . import store as _store
 from .store import (
@@ -57,23 +58,30 @@ class WriteBackCache:
         )
 
     def create(self, obj: APIObject) -> None:
-        if not self._store.put_if_absent(obj):
-            raise AlreadyExistsInCacheError(f"object {key_of(obj)} already exists")
-        self._queue.add_if_absent(create_request(obj))
+        with tracing.child_span(
+            "state.writeback.enqueue", {"op": "create", "kind": obj.KIND}
+        ):
+            if not self._store.put_if_absent(obj):
+                raise AlreadyExistsInCacheError(f"object {key_of(obj)} already exists")
+            self._queue.add_if_absent(create_request(obj))
 
     def get(self, namespace: str, name: str) -> Optional[APIObject]:
         return self._store.get((namespace, name))
 
     def update(self, obj: APIObject) -> None:
-        if self._store.get(key_of(obj)) is None:
-            raise NotInCacheError(f"object {key_of(obj)} does not exist")
-        self._store.put(obj)
-        self._queue.add_if_absent(update_request(obj))
+        with tracing.child_span(
+            "state.writeback.enqueue", {"op": "update", "kind": obj.KIND}
+        ):
+            if self._store.get(key_of(obj)) is None:
+                raise NotInCacheError(f"object {key_of(obj)} does not exist")
+            self._store.put(obj)
+            self._queue.add_if_absent(update_request(obj))
 
     def delete(self, namespace: str, name: str) -> None:
-        key = (namespace, name)
-        self._store.delete(key)
-        self._queue.add_if_absent(delete_request(key))
+        with tracing.child_span("state.writeback.enqueue", {"op": "delete"}):
+            key = (namespace, name)
+            self._store.delete(key)
+            self._queue.add_if_absent(delete_request(key))
 
     def list(self) -> List[APIObject]:
         return self._store.list()

@@ -396,3 +396,199 @@ def test_readiness_gates_on_solver_warmup(served):
         assert scheduler.wait_ready(timeout=5.0)
     finally:
         scheduler._warm_done.set()
+
+
+def _get_raw(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}", headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.headers, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def test_trace_id_sanitization(served):
+    """An unvalidated client header must not flow into response headers
+    or log lines: bad charset / oversized ids are replaced."""
+    _, _, http = served
+    payload = b'{"request": {"uid": "t", "objects": []}}'
+    for bad in ("evil\ninjected: header", "x" * 200, 'quo"te', "space id"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/convert", data=payload, method="POST"
+        )
+        req.add_unredirected_header("X-Trace-Id", bad.replace("\n", ""))
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            echoed = resp.headers.get("X-Trace-Id")
+            assert echoed != bad.replace("\n", "")
+            assert echoed and len(echoed) <= 64
+    # a well-formed id still round-trips
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http.port}/convert", data=payload,
+        headers={"X-Trace-Id": "good-id_123"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers.get("X-Trace-Id") == "good-id_123"
+
+
+def test_metrics_prometheus_negotiation(served):
+    api, scheduler, http = served
+    _create_nodes(api)
+    driver_json, _ = _driver_pod_json("app-prom")
+    api.create(serde.pod_from_dict(driver_json))
+    _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+
+    # default stays JSON (existing dashboards/tests read it)
+    status, body = _get(http.port, "/metrics")
+    assert status == 200 and "counters" in body
+
+    # Accept: text/plain → Prometheus exposition
+    status, headers, raw = _get_raw(
+        http.port, "/metrics", {"Accept": "text/plain;version=0.0.4"}
+    )
+    assert status == 200
+    assert headers.get("Content-Type").startswith("text/plain")
+    text = raw.decode()
+    assert "# TYPE foundry_spark_scheduler_requests counter" in text
+    assert 'outcome="success"' in text
+    # ?format=prometheus works without the header
+    status, _, raw2 = _get_raw(http.port, "/metrics?format=prometheus")
+    assert status == 200 and b"# TYPE" in raw2
+
+
+@pytest.fixture
+def served_fifo():
+    """Full wiring with the FIFO device queue solver (the acceptance
+    configuration: every predicate runs FIFO gate + binpack kernel)."""
+    api = APIServer()
+    api.create_crd(DEMAND_CRD_NAME, demand_crd_spec())
+    scheduler = init_server_with_clients(
+        api,
+        Install(binpack_algo="tpu-batch", fifo=True),
+        demand_poll_interval=0.02,
+    )
+    scheduler.lazy_demand_informer.wait_ready(5)
+    # force the XLA lane so the kernel profiler sees jit compile +
+    # execute even on hosts where the native C++ lane would serve
+    solver = scheduler.extender.binpacker.queue_solver
+    if solver is not None:
+        solver.backend = "xla"
+    http = ExtenderHTTPServer(scheduler, port=0)
+    http.start()
+    yield api, scheduler, http
+    http.stop()
+    scheduler.stop()
+
+
+def test_traces_cover_fifo_binpack_and_writeback(served_fifo):
+    """Acceptance: a predicate request produces a retrievable span tree
+    covering FIFO gate, binpack kernel (with compile/execute timings),
+    and reservation write-back; /metrics serves Prometheus text for the
+    same run."""
+    api, scheduler, http = served_fifo
+    _create_nodes(api, count=3)
+
+    # one earlier pending driver so the FIFO queue pass has real work
+    earlier = Harness.static_allocation_spark_pods("app-earlier", 1)[0]
+    api.create(earlier)
+    import time as _t
+
+    _t.sleep(0.05)  # strictly earlier creation timestamp
+    driver_json, _ = _driver_pod_json("app-traced", executors=1)
+    api.create(serde.pod_from_dict(driver_json))
+
+    status, result = _post(
+        http.port,
+        "/predicates",
+        {"Pod": driver_json, "NodeNames": ["n0", "n1", "n2"]},
+    )
+    assert status == 200 and result["NodeNames"]
+
+    status, body = _get(http.port, "/traces")
+    assert status == 200
+    traces = body["traces"]
+    assert traces, "no traces recorded"
+
+    def walk(span):
+        yield span
+        for c in span.get("children", ()):
+            yield from walk(c)
+
+    pod_name = driver_json["metadata"]["name"]
+    trace = next(
+        t
+        for t in traces
+        if any(s.get("tags", {}).get("pod") == pod_name for s in walk(t["root"]))
+    )
+    spans = list(walk(trace["root"]))
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+
+    assert "http.request" in by_name and "predicate" in by_name
+    # FIFO gate phase with the earlier driver counted
+    (gate,) = by_name["fifo_gate"]
+    assert gate["tags"]["earlierApps"] >= 1
+    assert gate["tags"]["earlierOk"] is True
+    # binpack kernel spans with the compile/execute split
+    kernel_spans = [s for s in spans if s["name"].startswith("kernel:")]
+    assert kernel_spans, [s["name"] for s in spans]
+    assert any("executeMs" in s["tags"] for s in kernel_spans)
+    assert any(
+        "compileMs" in s["tags"] or s["tags"].get("cacheHit") is True
+        for s in kernel_spans
+    )
+    # reservation write-back phase
+    (writeback,) = by_name["reservation.writeback"]
+    assert writeback["tags"]["app"] == "app-traced"
+    # the predicate span carries the decision tags
+    pred = by_name["predicate"][0]
+    assert pred["tags"]["outcome"] == "success"
+    assert pred["tags"]["node"] in ("n0", "n1", "n2")
+    # durations are measured and nested spans are bounded by the root
+    assert all(s["durationMs"] >= 0 for s in spans)
+    assert trace["durationMs"] >= pred["durationMs"]
+
+    # the same run exposes kernel metrics over valid Prometheus text
+    status, headers, raw = _get_raw(
+        http.port, "/metrics", {"Accept": "text/plain"}
+    )
+    assert status == 200
+    text = raw.decode()
+    assert "foundry_spark_scheduler_tpu_kernel_execute_time" in text
+    assert "foundry_spark_scheduler_tpu_kernel_cache_miss_count" in text
+    assert "foundry_spark_scheduler_trace_span_time" in text
+
+    # the application_scheduled event carries the same trace id
+    evts = scheduler.event_log.by_trace_id(trace["traceId"])
+    assert any(e.name.endswith("application_scheduled") for e in evts)
+
+
+def test_debug_schedule_endpoint(served_fifo):
+    api, scheduler, http = served_fifo
+    _create_nodes(api)
+    driver_json, _ = _driver_pod_json("app-debug", executors=1)
+    api.create(serde.pod_from_dict(driver_json))
+    _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+
+    pod_name = driver_json["metadata"]["name"]
+    status, headers, raw = _get_raw(http.port, f"/debug/schedule/{pod_name}")
+    assert status == 200
+    text = raw.decode()
+    assert "predicate" in text and "outcome=success" in text
+    assert "reservation.writeback" in text
+    # correlated events are appended
+    assert "application_scheduled" in text
+
+    status, _, _ = _get_raw(http.port, "/debug/schedule/no-such-pod")
+    assert status == 404
+
+
+def test_traces_limit_param(served_fifo):
+    api, scheduler, http = served_fifo
+    _create_nodes(api)
+    for i in range(3):
+        driver_json, _ = _driver_pod_json(f"app-lim-{i}", executors=1)
+        api.create(serde.pod_from_dict(driver_json))
+        _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+    status, body = _get(http.port, "/traces?limit=2")
+    assert status == 200 and len(body["traces"]) == 2

@@ -1,11 +1,14 @@
-"""Metrics reporters + waste reporter tests."""
+"""Metrics reporters + waste reporter tests, histogram reservoir
+sampling, and the Prometheus text exposition."""
 
+import re
 import time
 
 import pytest
 
 from k8s_spark_scheduler_tpu.metrics import names
-from k8s_spark_scheduler_tpu.metrics.registry import MetricsRegistry
+from k8s_spark_scheduler_tpu.metrics import prometheus as prom
+from k8s_spark_scheduler_tpu.metrics.registry import Histogram, MetricsRegistry
 from k8s_spark_scheduler_tpu.testing.harness import Harness
 from k8s_spark_scheduler_tpu.types.objects import DemandPhase
 
@@ -125,3 +128,98 @@ def test_time_to_first_bind_metric(harness):
     replacement.meta.name = "app-ttfb-exec-r"
     harness.assert_success(harness.schedule(replacement, ["n1", "n2"]))
     assert m.get_histogram(names.TIME_TO_FIRST_BIND)["count"] == after
+
+
+# -- histogram reservoir sampling -------------------------------------------
+
+
+def test_histogram_reservoir_is_unbiased_over_the_whole_stream():
+    """Algorithm R keeps a uniform sample of ALL updates.  The previous
+    ``count % cap`` overwrite kept only the last ~cap values, so a burst
+    at the end of the stream dragged every quantile to the burst value."""
+    h = Histogram(cap=512)
+    # 20k uniform values in [0, 1), then a 512-value burst at 100.0 —
+    # exactly one reservoir's worth, which the modulo scheme would have
+    # kept wholesale (p50 would report 100.0)
+    for i in range(20000):
+        h.update((i * 7919 % 20000) / 20000.0)
+    for _ in range(512):
+        h.update(100.0)
+    snap = h.snapshot()
+    assert snap["count"] == 20512
+    # the burst is ~2.5% of the stream: the median must stay in-body
+    assert snap["p50"] < 1.0, snap
+    assert abs(snap["p50"] - 0.5) < 0.1, snap
+    # true max is tracked exactly, not sampled
+    assert snap["max"] == 100.0
+
+
+def test_histogram_reservoir_is_deterministic():
+    def fill():
+        h = Histogram(cap=64)
+        for i in range(5000):
+            h.update(float(i % 997))
+        return h.snapshot()
+
+    assert fill() == fill()
+
+
+def test_histogram_small_stream_is_exact():
+    h = Histogram(cap=2048)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.update(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["p50"] == 2.0 and snap["max"] == 4.0
+
+
+# -- prometheus exposition ---------------------------------------------------
+
+_SERIES_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$"
+)
+
+
+def _assert_valid_exposition(text):
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|summary)$", line), line
+        else:
+            assert _SERIES_RE.match(line), line
+
+
+def test_prometheus_rendering_counter_gauge_histogram():
+    m = MetricsRegistry()
+    m.counter("foundry.spark.scheduler.requests", {"outcome": "success"}, inc=3)
+    m.counter("foundry.spark.scheduler.requests", {"outcome": "failure-fit"})
+    m.gauge("foundry.spark.scheduler.packing.efficiency", 0.75)
+    for v in (0.001, 0.002, 0.003):
+        m.histogram("foundry.spark.scheduler.schedule.time", v, {"role": "driver"})
+
+    text = prom.render(m)
+    _assert_valid_exposition(text)
+    assert "# TYPE foundry_spark_scheduler_requests counter" in text
+    assert 'foundry_spark_scheduler_requests{outcome="success"} 3' in text
+    assert 'foundry_spark_scheduler_requests{outcome="failure-fit"} 1' in text
+    assert "foundry_spark_scheduler_packing_efficiency 0.75" in text
+    assert "# TYPE foundry_spark_scheduler_schedule_time summary" in text
+    assert 'foundry_spark_scheduler_schedule_time{role="driver",quantile="0.5"} 0.002' in text
+    assert 'foundry_spark_scheduler_schedule_time_count{role="driver"} 3' in text
+    assert 'foundry_spark_scheduler_schedule_time_sum{role="driver"}' in text
+    assert 'foundry_spark_scheduler_schedule_time_max{role="driver"} 0.003' in text
+
+
+def test_prometheus_label_and_name_escaping():
+    m = MetricsRegistry()
+    m.counter(
+        "foundry.spark.scheduler.resource.usage.nvidia.com/gpu",
+        {"node-name": 'weird"quote\\slash\nnewline'},
+    )
+    text = prom.render(m)
+    _assert_valid_exposition(text)
+    # '/' and '.' sanitized out of the metric name; '-' out of the label
+    assert "foundry_spark_scheduler_resource_usage_nvidia_com_gpu{" in text
+    assert 'node_name="weird\\"quote\\\\slash\\nnewline"' in text
+
+
+def test_prometheus_empty_registry():
+    assert prom.render(MetricsRegistry()) == ""

@@ -28,10 +28,6 @@ from k8s_spark_scheduler_tpu.native.fifo import (
 )
 from k8s_spark_scheduler_tpu.ops.batch_solver import BIG, solve_queue
 
-pytestmark = pytest.mark.skipif(
-    not native_fifo_available(), reason="native toolchain unavailable"
-)
-
 N_NODES = 2000
 N_APPS = 200
 MIN_SPEEDUP = float(os.environ.get("PERF_GUARD_MIN_SPEEDUP", "4.0"))
@@ -59,6 +55,9 @@ def _best_of(fn, reps=3):
     return best
 
 
+@pytest.mark.skipif(
+    not native_fifo_available(), reason="native toolchain unavailable"
+)
 def test_native_lane_beats_xla_scan_by_4x():
     avail, rank, exec_ok, drivers, executors, counts, valid = _problem()
     dev_args = (
@@ -94,3 +93,87 @@ def test_native_lane_beats_xla_scan_by_4x():
         f"scan at {N_NODES}x{N_APPS} (native {native_s * 1e3:.1f}ms vs "
         f"xla {xla_s * 1e3:.1f}ms); bound is {MIN_SPEEDUP}x"
     )
+
+
+# -- tracing overhead guard --------------------------------------------------
+#
+# The observability layer must never silently regress the predicate hot
+# path.  Two bounds:
+#
+# (a) layer microbench: a full simulated request tree (root + 6 child
+#     spans + tags, serialized into the ring) must stay under a fixed
+#     per-request budget — catches an accidentally-expensive Span/ring
+#     implementation in isolation, load-robustly (best-of batches);
+# (b) end-to-end: predicate latency with tracing enabled stays within a
+#     relative+absolute budget of the same predicate with the tracer
+#     disabled (the no-op context-manager path).
+
+TRACE_TREE_BUDGET_US = float(os.environ.get("PERF_GUARD_TRACE_TREE_US", "120"))
+
+
+def test_span_tree_overhead_budget():
+    from k8s_spark_scheduler_tpu.tracing import Tracer
+
+    tracer = Tracer(capacity=64)
+
+    def one_request():
+        with tracer.span("http.request", {"path": "/predicates"}):
+            with tracer.span("predicate", {"pod": "p", "namespace": "d"}) as sp:
+                with tracer.span("reconcile"):
+                    pass
+                with tracer.span("fifo_gate", {"earlierApps": 3}):
+                    with tracer.span("kernel:fifo_queue", {"lane": "xla"}) as k:
+                        k.tag("executeMs", 0.2)
+                with tracer.span("binpack", {"policy": "tightly-pack"}):
+                    pass
+                with tracer.span("reservation.writeback", {"app": "a"}):
+                    pass
+                sp.tag("outcome", "success")
+
+    def batch():
+        for _ in range(200):
+            one_request()
+
+    batch()  # warm
+    per_request_s = _best_of(batch) / 200.0
+    assert per_request_s * 1e6 <= TRACE_TREE_BUDGET_US, (
+        f"tracing layer costs {per_request_s * 1e6:.1f}µs per request tree; "
+        f"budget is {TRACE_TREE_BUDGET_US}µs"
+    )
+
+
+def test_predicate_latency_with_tracing_within_budget():
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+    h = Harness()
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        driver = h.static_allocation_spark_pods("app-trace-perf", 1)[0]
+        h.assert_success(h.schedule(driver, ["n1", "n2"]))  # creates the RR
+
+        tracer = h.server.tracer
+        extender = h.server.extender
+        from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+        args = ExtenderArgs(pod=driver, node_names=["n1", "n2"])
+
+        # idempotent driver replay: a stable, reservation-backed request
+        # the harness can repeat without mutating cluster state
+        def batch():
+            for _ in range(50):
+                extender.predicate(args)
+
+        batch()  # warm both paths (jit, caches)
+        tracer.enabled = False
+        untraced_s = _best_of(batch)
+        tracer.enabled = True
+        traced_s = _best_of(batch)
+
+        budget = untraced_s * 1.5 + 50 * 2e-3  # 50% relative + 2ms/request
+        assert traced_s <= budget, (
+            f"tracing overhead: {traced_s * 1e3:.2f}ms per 50-request batch vs "
+            f"{untraced_s * 1e3:.2f}ms untraced (budget {budget * 1e3:.2f}ms)"
+        )
+    finally:
+        h.close()

@@ -1,0 +1,231 @@
+"""Tracing subsystem: span-tree assembly, ring eviction, context
+propagation, the kernel profiler's compile/execute split, and the
+events↔trace correlation."""
+
+import threading
+
+import pytest
+
+from k8s_spark_scheduler_tpu.events.events import EventLog
+from k8s_spark_scheduler_tpu.metrics import names as M
+from k8s_spark_scheduler_tpu.metrics.registry import MetricsRegistry
+from k8s_spark_scheduler_tpu.tracing import (
+    NOOP_SPAN,
+    Tracer,
+    add_tag,
+    child_span,
+    current_span,
+    current_trace_id,
+)
+from k8s_spark_scheduler_tpu.tracing.profiling import KernelProfiler
+
+
+def test_span_tree_assembly():
+    tracer = Tracer()
+    with tracer.span("root", {"pod": "p1"}) as root:
+        trace_id = root.trace_id
+        with tracer.span("phase-a") as a:
+            assert a.trace_id == trace_id
+            with tracer.span("kernel") as k:
+                k.tag("lane", "xla")
+        with tracer.span("phase-b"):
+            pass
+
+    assert len(tracer) == 1
+    (trace,) = tracer.traces()
+    assert trace["traceId"] == trace_id
+    tree = trace["root"]
+    assert tree["name"] == "root"
+    assert tree["tags"]["pod"] == "p1"
+    names = [c["name"] for c in tree["children"]]
+    assert names == ["phase-a", "phase-b"]
+    kernel = tree["children"][0]["children"][0]
+    assert kernel["name"] == "kernel"
+    assert kernel["tags"]["lane"] == "xla"
+    assert kernel["parentId"] == tree["children"][0]["spanId"]
+    # every span got a measured duration
+    assert tree["durationMs"] >= tree["children"][0]["durationMs"] >= 0
+
+
+def test_ring_eviction_keeps_newest():
+    tracer = Tracer(capacity=4)
+    for i in range(10):
+        with tracer.span("req", {"i": i}):
+            pass
+    traces = tracer.traces()
+    assert len(traces) == 4
+    # newest first
+    assert [t["root"]["tags"]["i"] for t in traces] == [9, 8, 7, 6]
+    assert tracer.traces(limit=2)[0]["root"]["tags"]["i"] == 9
+
+
+def test_trace_id_propagation_and_add_tag():
+    tracer = Tracer()
+    assert current_trace_id() is None
+    with tracer.span("root", trace_id="abc123") as root:
+        assert current_trace_id() == "abc123"
+        add_tag("k", "v")
+        with tracer.span("child"):
+            assert current_trace_id() == "abc123"
+        assert current_span() is root
+    assert current_trace_id() is None
+    assert tracer.traces()[0]["root"]["tags"]["k"] == "v"
+
+
+def test_disabled_tracer_is_noop():
+    tracer = Tracer(enabled=False)
+    span = tracer.span("x")
+    assert span is NOOP_SPAN
+    with span as s:
+        s.tag("a", 1)  # swallowed
+        assert current_trace_id() is None
+    assert len(tracer) == 0
+
+
+def test_child_span_without_active_trace_is_noop():
+    assert child_span("orphan") is NOOP_SPAN
+    tracer = Tracer()
+    with tracer.span("root"):
+        with child_span("attached", {"x": 1}) as sp:
+            assert sp is not NOOP_SPAN
+    tree = tracer.traces()[0]["root"]
+    assert tree["children"][0]["name"] == "attached"
+
+
+def test_find_by_tag_matches_nested_spans():
+    tracer = Tracer()
+    with tracer.span("root"):
+        with tracer.span("inner", {"pod": "needle"}):
+            pass
+    with tracer.span("root2", {"pod": "other"}):
+        pass
+    hit = tracer.find_by_tag("pod", "needle")
+    assert hit is not None and hit["root"]["name"] == "root"
+    assert tracer.find_by_tag("pod", "missing") is None
+
+
+def test_spans_record_per_phase_histograms():
+    metrics = MetricsRegistry()
+    tracer = Tracer(metrics=metrics)
+    with tracer.span("root"):
+        with tracer.span("phase-a"):
+            pass
+    assert metrics.get_histogram(M.TRACE_SPAN_TIME, {M.TAG_SPAN: "root"})["count"] == 1
+    assert metrics.get_histogram(M.TRACE_SPAN_TIME, {M.TAG_SPAN: "phase-a"})["count"] == 1
+
+
+def test_error_tag_on_exception():
+    tracer = Tracer()
+    with pytest.raises(ValueError):
+        with tracer.span("root"):
+            raise ValueError("boom")
+    tags = tracer.traces()[0]["root"]["tags"]
+    assert "ValueError" in tags["error"]
+
+
+def test_threaded_traces_are_isolated():
+    tracer = Tracer(capacity=64)
+    errors = []
+
+    def work(i):
+        try:
+            with tracer.span("req", {"i": i}):
+                assert current_span().tags["i"] == i
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(tracer) == 16
+    # 16 distinct traces, not one interleaved tree
+    assert len({t["traceId"] for t in tracer.traces()}) == 16
+
+
+# -- kernel profiler ---------------------------------------------------------
+
+
+def test_profiler_compile_vs_execute_split_jit():
+    import jax
+    import jax.numpy as jnp
+
+    metrics = MetricsRegistry()
+    tracer = Tracer()
+    prof = KernelProfiler(metrics=metrics, tracer=tracer)
+
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    tags = {M.TAG_KERNEL: "f", M.TAG_LANE: "xla"}
+    with tracer.span("root"):
+        with prof.profile("f", lane="xla", fn=f) as rec:
+            out = f(jnp.ones((8,)))
+            rec.sync(out)
+        with prof.profile("f", lane="xla", fn=f) as rec:
+            out = f(jnp.ones((8,)))
+            rec.sync(out)
+
+    assert metrics.get_counter(M.KERNEL_CACHE_MISSES, tags) == 1.0
+    assert metrics.get_counter(M.KERNEL_CACHE_HITS, tags) == 1.0
+    assert metrics.get_histogram(M.KERNEL_COMPILE_TIME, tags)["count"] == 1
+    assert metrics.get_histogram(M.KERNEL_EXECUTE_TIME, tags)["count"] == 2
+    # compile (trace+lower+compile) dwarfs steady-state execute on CPU
+    assert (
+        metrics.get_histogram(M.KERNEL_COMPILE_TIME, tags)["max"]
+        > metrics.get_histogram(M.KERNEL_EXECUTE_TIME, tags)["p50"]
+    )
+    # spans carry the same split
+    kernel_spans = [
+        s
+        for s in _walk(tracer.traces()[0]["root"])
+        if s["name"] == "kernel:f"
+    ]
+    assert len(kernel_spans) == 2
+    assert {s["tags"]["cacheHit"] for s in kernel_spans} == {True, False}
+    assert "compileMs" in kernel_spans[0]["tags"] or "compileMs" in kernel_spans[1]["tags"]
+
+
+def test_profiler_shape_key_fallback_and_native_lane():
+    metrics = MetricsRegistry()
+    prof = KernelProfiler(metrics=metrics, tracer=Tracer())
+    tags = {M.TAG_KERNEL: "k", M.TAG_LANE: "pallas"}
+    with prof.profile("k", lane="pallas", shape_key=(4, 3)):
+        pass
+    with prof.profile("k", lane="pallas", shape_key=(4, 3)):
+        pass
+    with prof.profile("k", lane="pallas", shape_key=(8, 3)):
+        pass
+    assert metrics.get_counter(M.KERNEL_CACHE_MISSES, tags) == 2.0
+    assert metrics.get_counter(M.KERNEL_CACHE_HITS, tags) == 1.0
+
+    ntags = {M.TAG_KERNEL: "n", M.TAG_LANE: "native"}
+    with prof.profile("n", lane="native", jit=False):
+        pass
+    assert metrics.get_histogram(M.KERNEL_EXECUTE_TIME, ntags)["count"] == 1
+    assert metrics.get_counter(M.KERNEL_CACHE_MISSES, ntags) == 0.0
+
+
+def _walk(span):
+    yield span
+    for c in span.get("children", ()):
+        yield from _walk(c)
+
+
+# -- events correlation ------------------------------------------------------
+
+
+def test_events_stamp_trace_id():
+    log = EventLog()
+    tracer = Tracer()
+    with tracer.span("root", trace_id="tr-42"):
+        log.emit("some.event", foo="bar")
+    log.emit("untraced.event")
+    traced = log.by_trace_id("tr-42")
+    assert len(traced) == 1 and traced[0].values["foo"] == "bar"
+    assert log.all()[-1].trace_id == ""
+    # empty trace_id never matches
+    assert log.by_trace_id("") == []
