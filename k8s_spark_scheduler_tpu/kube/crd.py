@@ -117,8 +117,8 @@ def ensure_resource_reservations_crd(
         logger.info("upgrading resource reservation CRD")
         api.update_crd(RESOURCE_RESERVATION_CRD_NAME, desired)
 
-    deadline = time.time() + timeout_seconds
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout_seconds
+    while time.monotonic() < deadline:
         if api.crd_established(RESOURCE_RESERVATION_CRD_NAME):
             return
         time.sleep(0.05)

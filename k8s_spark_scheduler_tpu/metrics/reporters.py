@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import List, Optional
 
+from .. import timesource
 from ..scheduler import labels as L
 from ..types.resources import Resources
 from . import names
@@ -51,7 +51,7 @@ class ReporterSet:
         created = pod.creation_timestamp
         if not created:
             return
-        lag = max(time.time() - created, 0.0)
+        lag = max(timesource.now() - created, 0.0)
         if lag < 300.0:  # only fresh pods are a meaningful delay signal
             with self._delay_lock:
                 self._delays.append(lag)
@@ -116,7 +116,7 @@ class ReporterSet:
 
     def report_pod_lifecycle(self) -> None:
         server = self._server
-        now = time.time()
+        now = timesource.now()
         pending_ages: List[float] = []
         for pod in server.pod_informer.list():
             if not L.is_spark_scheduler_pod(pod):

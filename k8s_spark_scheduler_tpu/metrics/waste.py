@@ -16,10 +16,10 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
+from .. import timesource
 from ..demands.manager import pod_name_from_demand
 from ..scheduler import labels as L
 from ..types.objects import Demand, Pod
@@ -39,7 +39,7 @@ class _PodSchedulingInfo:
     demand_fulfilled_at: Optional[float] = None
     last_failure_at: Optional[float] = None
     last_failure_outcome: str = ""
-    created_at: float = field(default_factory=time.time)
+    created_at: float = field(default_factory=timesource.now)
 
 
 class WasteMetricsReporter:
@@ -76,7 +76,7 @@ class WasteMetricsReporter:
         """waste.go:147-186 (channel replaced by a direct locked update)."""
         with self._lock:
             info = self._get_or_create(pod.namespace, pod.name)
-            info.last_failure_at = time.time()
+            info.last_failure_at = timesource.now()
             info.last_failure_outcome = outcome
 
     def _on_demand_created(self, demand: Demand) -> None:
@@ -86,7 +86,7 @@ class WasteMetricsReporter:
             # the demand's own creation timestamp, not delivery time
             # (waste.go:245-254) — synthetic informer replays after a
             # restart must not reset the phase boundary
-            info.demand_created_at = demand.creation_timestamp or time.time()
+            info.demand_created_at = demand.creation_timestamp or timesource.now()
 
     def _on_demand_update(self, old: Demand, new: Demand) -> None:
         from ..types.objects import DemandPhase
@@ -96,7 +96,7 @@ class WasteMetricsReporter:
             pod_name = pod_name_from_demand(new)
             with self._lock:
                 info = self._get_or_create(new.namespace, pod_name)
-                info.demand_fulfilled_at = time.time()
+                info.demand_fulfilled_at = timesource.now()
                 info.demand_created_at = new.creation_timestamp or info.demand_created_at
 
     def _on_pod_update(self, old: Optional[Pod], new: Pod) -> None:
@@ -106,7 +106,7 @@ class WasteMetricsReporter:
 
     def _on_pod_scheduled(self, pod: Pod) -> None:
         """waste.go:196-222."""
-        now = time.time()
+        now = timesource.now()
         with self._lock:
             info = self._info.pop((pod.namespace, pod.name), None)
         instance_group, _ = L.find_instance_group_from_pod_spec(pod, self._instance_group_label)
@@ -193,7 +193,7 @@ class WasteMetricsReporter:
 
     def cleanup_metric_cache(self) -> None:
         """waste.go:160-172: drop entries older than 6h."""
-        cutoff = time.time() - DEMAND_FULFILLED_AGE_CLEANUP_SECONDS
+        cutoff = timesource.now() - DEMAND_FULFILLED_AGE_CLEANUP_SECONDS
         with self._lock:
             stale = [k for k, v in self._info.items() if v.created_at < cutoff]
             for k in stale:

@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .. import compat
+from .. import timesource
 from ..config import FifoConfig
 from ..tracing import spans as tracing
 from ..demands.manager import DemandManager
@@ -235,7 +236,7 @@ class SparkSchedulerExtender:
         self._metrics.histogram(mnames.SCHEDULING_PROCESSING_TIME, time.perf_counter() - t0, tags)
         self._metrics.counter(mnames.REQUEST_COUNTER, tags)
         if pod is not None:
-            now = time.time()
+            now = timesource.now()
             created = pod.creation_timestamp or now
             scheduled_condition = pod.conditions.get("PodScheduled")
             is_retry = scheduled_condition is not None
@@ -268,7 +269,7 @@ class SparkSchedulerExtender:
 
     def _reconcile_if_needed(self) -> None:
         """resource.go:194-205."""
-        now = time.time()
+        now = timesource.now()
         if now > self._last_request + LEADER_ELECTION_INTERVAL_SECONDS:
             from ..metrics import names as mnames
             from .failover import sync_resource_reservations_and_demands
@@ -625,7 +626,7 @@ class SparkSchedulerExtender:
         enforce_after = self._fifo_config.enforce_after_pod_age_by_instance_group.get(
             instance_group, self._fifo_config.default_enforce_after_pod_age
         )
-        return time.time() - enforce_after
+        return timesource.now() - enforce_after
 
     # -- executor path -------------------------------------------------------
 

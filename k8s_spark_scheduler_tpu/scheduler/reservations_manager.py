@@ -9,6 +9,7 @@ import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from .. import timesource
 from ..kube.informer import Informer
 from ..state.softreservations import SoftReservation, SoftReservationStore
 from ..state.typed_caches import ResourceReservationCache
@@ -307,11 +308,10 @@ class ResourceReservationManager:
         # time-to-first-bind metric + slow log, only on the reservation's
         # first binding (resourcereservations.go:364-387)
         if first_bind and rr.meta.creation_timestamp:
-            import time as _time
 
             from ..metrics import names as mnames
 
-            duration = _time.time() - rr.meta.creation_timestamp
+            duration = timesource.now() - rr.meta.creation_timestamp
             self._metrics.histogram(mnames.TIME_TO_FIRST_BIND, duration)
             snap = self._metrics.get_histogram(mnames.TIME_TO_FIRST_BIND)
             self._metrics.gauge(mnames.TIME_TO_FIRST_BIND_MEDIAN, snap["p50"])

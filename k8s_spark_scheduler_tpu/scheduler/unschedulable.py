@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import timesource
 from ..kube.apiserver import APIServer
 from ..kube.informer import Informer
 from ..ops.registry import Binpacker
@@ -80,7 +81,7 @@ class UnschedulablePodMarker:
         Quantity metadata and ran a full pack PER POD every interval
         (tens of seconds of CPU that, on a small host, came straight
         out of live Filter latency)."""
-        now = time.time()
+        now = timesource.now()
         meta_cache: dict = {}
         verdict_cache: dict = {}
         for pod in self._pod_informer.list():
@@ -210,7 +211,7 @@ class UnschedulablePodMarker:
         try:
             fresh = self._api.get(Pod.KIND, driver.namespace, driver.name)
             fresh.conditions[POD_EXCEEDS_CLUSTER_CAPACITY] = PodCondition(
-                type=POD_EXCEEDS_CLUSTER_CAPACITY, status=status, transition_time=time.time()
+                type=POD_EXCEEDS_CLUSTER_CAPACITY, status=status, transition_time=timesource.now()
             )
             self._api.update(fresh)
         except Exception:

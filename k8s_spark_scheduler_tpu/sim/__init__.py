@@ -1,0 +1,25 @@
+"""Deterministic discrete-event cluster simulator.
+
+Drives the REAL scheduler wiring (embedded API server, full
+``server/wiring.py`` Server, real solver lanes) on a virtual clock:
+
+- :mod:`.clock` — event heap + controllable time source (installed into
+  :mod:`..timesource` so GC/failover/FIFO/unschedulable timers fire at
+  simulated instants);
+- :mod:`.workload` — seeded arrival/size/lifetime generators and JSONL
+  trace replay;
+- :mod:`.scenario` — declarative spec composing cluster shape, workload,
+  autoscaler behavior, and injected faults;
+- :mod:`.auditor` — per-event invariant auditing through
+  ``scheduler/invariants.py`` plus FIFO-order and demand-hygiene checks;
+- :mod:`.runner` — the engine + replayable event log with a content
+  digest (same seed ⇒ identical digest) and a summary JSON.
+
+CLI: ``python -m k8s_spark_scheduler_tpu.sim --scenario examples/sim/chaos.json --seed 42``
+"""
+
+from .clock import VirtualClock
+from .scenario import Scenario
+from .runner import Simulation, SimulationResult
+
+__all__ = ["VirtualClock", "Scenario", "Simulation", "SimulationResult"]

@@ -1,0 +1,116 @@
+"""Per-event invariant auditing.
+
+After every simulated event (post-quiesce, so async write-back has
+drained and the local caches agree with the API server) the auditor
+runs:
+
+1. the full ``scheduler/invariants.py`` suite (I1–I5: reservation⇄pod
+   consistency, no double-binding, soft-reservation hygiene, **no node
+   over-commit**, tensor-mirror exactness);
+2. FIFO-order checks over the scheduling round's decisions: the runner
+   attempts pending drivers in strict (creation, app_id) order — the
+   order kube-scheduler's queue would present them — and a round where
+   an earlier same-instance-group driver was refused with
+   ``failure-earlier-driver`` while a LATER driver succeeded is an
+   order inversion (a later driver succeeding after an earlier one
+   fails ``failure-fit`` is legitimate: the FIFO feasibility pass
+   reserves the earlier gang's space, it doesn't hard-block the queue);
+3. demand hygiene: after quiesce, every Demand's owner pod must still
+   exist and still be unscheduled — a demand surviving its pod's
+   scheduling means the inline delete AND DemandGC both missed it, a
+   demand for a deleted pod means owner GC missed it (the "demands
+   created/deleted exactly when the reference would" check in
+   observable terms).
+
+Violations accumulate in ``violations`` (the run fails its acceptance
+bar when non-empty) and are counted into the PR 1 metrics registry
+under ``sim.audit.violations``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..demands.manager import pod_name_from_demand
+from ..scheduler import invariants
+from ..scheduler.extender import FAILURE_EARLIER_DRIVER
+from ..types.objects import Demand, Pod
+
+
+@dataclass
+class Decision:
+    """One predicate outcome inside a scheduling round."""
+
+    pod_name: str
+    role: str  # "driver" | "executor"
+    instance_group: str
+    created: float
+    outcome: str  # success | the failure-* outcomes
+    node: str = ""
+
+
+class Auditor:
+    def __init__(self, server, metrics=None):
+        self._server = server
+        self._metrics = metrics if metrics is not None else server.metrics
+        self.violations: List[str] = []
+        self.events_audited = 0
+
+    # -- per-round decision checks -------------------------------------------
+
+    def check_round(self, decisions: List[Decision], label: str) -> None:
+        """FIFO-order audit over one scheduling round's driver decisions."""
+        drivers = [d for d in decisions if d.role == "driver"]
+        # the runner must present drivers oldest-first (per group the
+        # arrival order IS the FIFO order); a mis-sorted round would
+        # make every downstream FIFO conclusion vacuous, so audit it
+        by_group: dict = {}
+        for d in drivers:
+            by_group.setdefault(d.instance_group, []).append(d)
+        for group, ds in by_group.items():
+            keys = [(d.created, d.pod_name) for d in ds]
+            if keys != sorted(keys):
+                self._violate(
+                    f"F0[{label}]: round attempted {group} drivers out of arrival order: {keys}"
+                )
+            blocked_behind_earlier = None
+            for d in ds:
+                if d.outcome == FAILURE_EARLIER_DRIVER and blocked_behind_earlier is None:
+                    blocked_behind_earlier = d
+                elif blocked_behind_earlier is not None and d.outcome == "success":
+                    self._violate(
+                        f"F1[{label}]: driver {d.pod_name} succeeded after earlier "
+                        f"driver {blocked_behind_earlier.pod_name} (same group "
+                        f"{group}) was refused with failure-earlier-driver"
+                    )
+
+    # -- per-event state checks ----------------------------------------------
+
+    def check_state(self, label: str) -> None:
+        """Invariants I1–I5 + demand hygiene against quiesced state."""
+        self.events_audited += 1
+        for v in invariants.check(self._server, raise_on_violation=False):
+            self._violate(f"{v} [{label}]")
+        self._check_demand_hygiene(label)
+        self._metrics.gauge("sim.audit.events", float(self.events_audited))
+
+    def _check_demand_hygiene(self, label: str) -> None:
+        api = self._server.api
+        pods = {(p.namespace, p.name): p for p in api.list(Pod.KIND)}
+        for demand in api.list(Demand.KIND):
+            pod_name = pod_name_from_demand(demand)
+            pod = pods.get((demand.namespace, pod_name))
+            if pod is None:
+                self._violate(
+                    f"D1[{label}]: demand {demand.name} outlives its pod {pod_name}"
+                )
+            elif pod.node_name:
+                self._violate(
+                    f"D2[{label}]: demand {demand.name} still present after pod "
+                    f"{pod_name} was scheduled to {pod.node_name}"
+                )
+
+    def _violate(self, message: str) -> None:
+        self.violations.append(message)
+        self._metrics.counter("sim.audit.violations")

@@ -1,0 +1,724 @@
+"""The discrete-event simulation engine.
+
+Builds the REAL wiring (testing/harness.py → server/wiring.py → embedded
+API server + full extender stack), installs a :class:`~.clock.VirtualClock`
+as the process time source, and replays a :class:`~.scenario.Scenario`:
+app arrivals from the workload generator, retry ticks (the
+kube-scheduler requeue analog), fault injections, delayed autoscaler
+fulfillment, and app completions — auditing invariants after every
+event and appending each event to a replayable log whose SHA-256 digest
+is byte-identical for identical (scenario, seed).
+
+Determinism contract (what the digest covers and why it is stable):
+
+- virtual times only — wall-clock never enters the log (latencies go to
+  the summary, which is NOT digested);
+- object names from per-instance counters (harness/autoscaler) and the
+  seeded workload;
+- every event quiesces the async write-back queues before the state
+  fingerprint is taken, so thread interleavings inside an event window
+  cannot reorder observable state;
+- the fingerprint excludes uids and resourceVersions (assigned in
+  write-back-thread arrival order) but covers every scheduling-relevant
+  field: bindings, reservations (hard + soft), demands, node state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import timesource
+from ..scheduler import labels as L
+from ..scheduler.failover import sync_resource_reservations_and_demands
+from ..testing.fake_autoscaler import FakeAutoscaler
+from ..testing.harness import Harness
+from ..types.objects import Demand, Node, Pod, ResourceReservation
+from ..types.resources import Resources, usage_for_nodes
+from .auditor import Auditor, Decision
+from .clock import VirtualClock
+from .scenario import FaultSpec, Scenario
+from .workload import AppSpec, WorkloadGenerator
+
+# virtual epoch: away from 0 so no timestamp is falsy (ensure_identity
+# treats 0.0 as unset) and clearly not a real epoch in logs
+SIM_EPOCH = 1_000_000.0
+
+
+@dataclass
+class _App:
+    spec: AppSpec
+    state: str = "pending"  # pending | running | done | dead
+    driver_name: str = ""
+    executor_template: Optional[Pod] = None
+    next_exec_idx: int = 1
+    executor_names: List[str] = field(default_factory=list)
+    completion_scheduled: bool = False
+
+
+@dataclass
+class SimulationResult:
+    digest: str
+    summary: Dict
+    event_log: List[Dict]
+    violations: List[str]
+
+
+class Simulation:
+    def __init__(self, scenario: Scenario):
+        self.scenario = scenario
+        self.clock = VirtualClock(start=SIM_EPOCH)
+        self._rng = random.Random(scenario.seed ^ 0xFA17)
+        self._apps: Dict[str, _App] = {}
+        self._log: List[Dict] = []
+        self._latencies: List[float] = []
+        self._queue_depths: List[int] = []
+        self._efficiencies: List[float] = []
+        self._seq = 0
+        self._killed_nodes = 0
+        self._scaler: Optional[FakeAutoscaler] = None
+        self._pumps_scheduled: set = set()
+        self.harness: Optional[Harness] = None
+        self.auditor: Optional[Auditor] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def run(self) -> SimulationResult:
+        sc = self.scenario
+        t_wall0 = time.perf_counter()
+        timesource.set_source(self.clock.now)
+        try:
+            self._build()
+            self._seed_events()
+            horizon = SIM_EPOCH + sc.duration
+            while True:
+                nxt = self.clock.peek_time()
+                if nxt is None or nxt > horizon:
+                    break
+                self.clock.run_next()
+            # drain: one final round + audit so the log always ends on
+            # quiesced, audited state
+            self._process("end", self._round("end"))
+        finally:
+            try:
+                if self.harness is not None:
+                    self.harness.close()
+            finally:
+                timesource.reset()
+        wall_s = time.perf_counter() - t_wall0
+        return self._result(wall_s)
+
+    def _build(self) -> None:
+        sc = self.scenario
+        self.harness = Harness(
+            binpack_algo=sc.binpack_algo,
+            is_fifo=sc.fifo,
+            # the marker thread would mutate pod conditions at wall-clock
+            # instants (nondeterministic vs the event stream); scans are
+            # sim-driven via unschedulable_scan_interval instead
+            unschedulable_polling_interval=1e9,
+        )
+        for i in range(sc.cluster.nodes):
+            zone = sc.cluster.zones[i % len(sc.cluster.zones)]
+            self.harness.new_node(
+                f"node-{i + 1:03d}",
+                cpu=sc.cluster.cpu,
+                memory=sc.cluster.memory,
+                gpu=sc.cluster.gpu,
+                zone=zone,
+                instance_group=sc.cluster.instance_group,
+            )
+        if sc.autoscaler.enabled:
+            informer = self.harness.server.lazy_demand_informer.informer()
+            self._scaler = FakeAutoscaler(
+                self.harness.api,
+                informer,
+                node_cpu=sc.autoscaler.node_cpu,
+                node_memory=sc.autoscaler.node_memory,
+                node_gpu=sc.autoscaler.node_gpu,
+                default_zone=sc.cluster.zones[0],
+                fulfillment_delay=sc.autoscaler.delay,
+                max_nodes=sc.autoscaler.max_nodes,
+                deferred=True,  # determinism: fulfill only at virtual pumps
+            )
+        self.auditor = Auditor(self.harness.server)
+
+    def _seed_events(self) -> None:
+        sc = self.scenario
+        apps = WorkloadGenerator(sc.workload, sc.seed).generate(sc.duration)
+        self.workload = apps
+        for app in apps:
+            self.clock.schedule(
+                SIM_EPOCH + app.arrival,
+                f"arrival:{app.app_id}",
+                lambda a=app: self._on_arrival(a),
+            )
+        for fault in sc.faults:
+            self.clock.schedule(
+                SIM_EPOCH + fault.at,
+                f"fault:{fault.kind}",
+                lambda f=fault: self._on_fault(f),
+            )
+        interval = max(sc.retry_interval, 0.5)
+        t = interval
+        while t < sc.duration:
+            self.clock.schedule(SIM_EPOCH + t, "tick", self._on_tick)
+            t += interval
+        if sc.unschedulable_scan_interval > 0:
+            t = sc.unschedulable_scan_interval
+            while t < sc.duration:
+                self.clock.schedule(SIM_EPOCH + t, "unschedulable-scan", self._on_unschedulable_scan)
+                t += sc.unschedulable_scan_interval
+
+    # -- event handlers -------------------------------------------------------
+
+    def _on_arrival(self, spec: AppSpec) -> None:
+        h = self.harness
+        if spec.dynamic:
+            pods = h.dynamic_allocation_spark_pods(
+                spec.app_id,
+                spec.min_executor_count,
+                spec.executor_count,
+                driver_cpu=spec.driver_cpu,
+                driver_mem=spec.driver_mem,
+                executor_cpu=spec.executor_cpu,
+                executor_mem=spec.executor_mem,
+                instance_group=spec.instance_group,
+                namespace=spec.namespace,
+                creation_timestamp=self.clock.now(),
+            )
+        else:
+            pods = h.static_allocation_spark_pods(
+                spec.app_id,
+                spec.executor_count,
+                driver_cpu=spec.driver_cpu,
+                driver_mem=spec.driver_mem,
+                executor_cpu=spec.executor_cpu,
+                executor_mem=spec.executor_mem,
+                instance_group=spec.instance_group,
+                namespace=spec.namespace,
+                creation_timestamp=self.clock.now(),
+            )
+        driver, executors = pods[0], pods[1:]
+        app = _App(spec=spec, driver_name=driver.name)
+        app.executor_template = executors[0].deepcopy() if executors else None
+        self._apps[spec.app_id] = app
+        h.create_pod(driver)
+        self._process(f"arrival:{spec.app_id}", self._round(f"arrival:{spec.app_id}"))
+
+    def _on_tick(self) -> None:
+        fulfilled = self._pump_autoscaler()
+        decisions = self._round("tick")
+        # empty ticks (no decisions, no scale-up) are audited but not
+        # logged: the log stays a record of activity, and an idle tail
+        # can't pad the digest
+        if decisions or fulfilled:
+            self._process("tick", decisions)
+        else:
+            self._audit_only("tick")
+
+    def _on_scaler_pump(self, due: float) -> None:
+        # NOTE: due stays in _pumps_scheduled — a capped demand keeps its
+        # (now past) due time forever, and re-scheduling it would replay
+        # the same instant endlessly (a virtual-time livelock).  Capped
+        # demands are retried by the regular tick pump instead.
+        fulfilled = self._pump_autoscaler()
+        decisions = self._round("scale-up")
+        if decisions or fulfilled:
+            self._process("scale-up", decisions)
+
+    def _on_unschedulable_scan(self) -> None:
+        self.harness.server.unschedulable_marker.scan_for_unschedulable_pods()
+        self._process("unschedulable-scan", [])
+
+    def _on_complete(self, app_id: str) -> None:
+        app = self._apps.get(app_id)
+        if app is None or app.state != "running":
+            return
+        h = self.harness
+        # executors terminate first, driver last (Spark teardown order);
+        # deleting the driver cascades the RR + demands via owner GC
+        names = [n for n in app.executor_names] + [app.driver_name]
+        for name in names:
+            pod = h.server.pod_informer.get(app.spec.namespace, name)
+            if pod is None:
+                continue
+            if pod.node_name:
+                h.terminate_pod(pod)
+            h.api.delete(Pod.KIND, pod.namespace, pod.name)
+        app.state = "done"
+        self._process(f"complete:{app_id}", self._round(f"complete:{app_id}"))
+
+    # -- faults ---------------------------------------------------------------
+
+    def _on_fault(self, fault: FaultSpec) -> None:
+        label = f"fault:{fault.kind}"
+        if fault.kind == "node_kill":
+            self._fault_node_kill(fault)
+        elif fault.kind == "node_cordon":
+            self._fault_cordon(fault, cordon=True)
+        elif fault.kind == "node_uncordon":
+            self._fault_cordon(fault, cordon=False)
+        elif fault.kind == "executor_storm":
+            self._fault_executor_storm(fault)
+        elif fault.kind == "failover":
+            self._fault_failover()
+        self._process(label, self._round(label))
+
+    def _fault_node_kill(self, fault: FaultSpec) -> None:
+        h = self.harness
+        names = sorted(n.name for n in h.api.list(Node.KIND))
+        victims = self._rng.sample(names, min(fault.count, len(names)))
+        for victim in sorted(victims):
+            # driver deaths tear whole apps down first
+            for pod in sorted(h.api.list(Pod.KIND), key=lambda p: p.name):
+                if pod.node_name != victim:
+                    continue
+                if pod.labels.get(L.SPARK_ROLE_LABEL) == L.DRIVER:
+                    self._kill_app(pod.labels.get(L.SPARK_APP_ID_LABEL, ""))
+            # surviving pods on the node are executor deaths
+            for pod in sorted(h.api.list(Pod.KIND), key=lambda p: p.name):
+                if pod.node_name != victim:
+                    continue
+                if pod.labels.get(L.SPARK_ROLE_LABEL) == L.EXECUTOR:
+                    self._kill_executor(pod, replace=True)
+            h.api.delete(Node.KIND, "default", victim)
+            self._killed_nodes += 1
+
+    def _fault_cordon(self, fault: FaultSpec, cordon: bool) -> None:
+        h = self.harness
+        candidates = sorted(
+            n.name for n in h.api.list(Node.KIND) if n.unschedulable != cordon
+        )
+        victims = self._rng.sample(candidates, min(fault.count, len(candidates)))
+        for name in sorted(victims):
+            fresh = h.api.get(Node.KIND, "default", name)
+            fresh.unschedulable = cordon
+            h.api.update(fresh)
+
+    def _fault_executor_storm(self, fault: FaultSpec) -> None:
+        h = self.harness
+        running = sorted(
+            app_id for app_id, a in self._apps.items() if a.state == "running"
+        )
+        targets = self._rng.sample(running, min(fault.apps, len(running)))
+        for app_id in sorted(targets):
+            app = self._apps[app_id]
+            bound = [
+                p
+                for name in sorted(app.executor_names)
+                if (p := h.server.pod_informer.get(app.spec.namespace, name)) is not None
+                and p.node_name
+            ]
+            if not bound:
+                continue
+            k = max(1, int(len(bound) * fault.fraction))
+            victims = self._rng.sample([p.name for p in bound], k)
+            # simultaneous deaths, then simultaneous replacements — the
+            # tombstone race shape in state/softreservations.py
+            for name in sorted(victims):
+                pod = h.server.pod_informer.get(app.spec.namespace, name)
+                if pod is not None:
+                    self._kill_executor(pod, replace=False)
+            for _ in sorted(victims):
+                self._spawn_replacement_executor(app)
+
+    def _fault_failover(self) -> None:
+        """A leader change: the in-memory (intentionally unpersisted)
+        soft-reservation state is lost; the new leader's first act is
+        failover reconciliation rebuilding it from cluster state."""
+        server = self.harness.server
+        extender = server.extender
+        soft = server.soft_reservation_store
+        for app_id in sorted(soft.get_all_soft_reservations_copy()):
+            soft.remove_driver_reservation(app_id)
+        with extender._predicate_lock:
+            sync_resource_reservations_and_demands(extender)
+
+    def _kill_app(self, app_id: str) -> None:
+        app = self._apps.get(app_id)
+        h = self.harness
+        if app is None:
+            return
+        for name in [app.driver_name] + list(app.executor_names):
+            pod = h.server.pod_informer.get(app.spec.namespace, name)
+            if pod is not None:
+                try:
+                    h.api.delete(Pod.KIND, pod.namespace, pod.name)
+                except Exception:
+                    pass
+        app.state = "dead"
+
+    def _kill_executor(self, pod: Pod, replace: bool) -> None:
+        h = self.harness
+        app = self._apps.get(pod.labels.get(L.SPARK_APP_ID_LABEL, ""))
+        try:
+            h.api.delete(Pod.KIND, pod.namespace, pod.name)
+        except Exception:
+            return
+        if app is not None:
+            if pod.name in app.executor_names:
+                app.executor_names.remove(pod.name)
+            if replace and app.state == "running":
+                self._spawn_replacement_executor(app)
+
+    def _spawn_replacement_executor(self, app: _App) -> None:
+        """Spark submits a fresh executor pod (new name) to replace a
+        dead one; the extender must re-claim the now-unbound reservation
+        (or a soft spot for DA extras)."""
+        if app.executor_template is None:
+            return
+        idx = app.spec.executor_count + app.next_exec_idx
+        app.next_exec_idx += 1
+        pod = app.executor_template.deepcopy()
+        pod.meta.name = f"{app.spec.app_id}-exec-{idx}"
+        pod.meta.creation_timestamp = self.clock.now()
+        pod.meta.resource_version = 0
+        pod.meta.uid = ""
+        pod.node_name = ""
+        self.harness.create_pod(pod)
+        app.executor_names.append(pod.meta.name)
+
+    # -- scheduling rounds ----------------------------------------------------
+
+    def _pump_autoscaler(self) -> int:
+        if self._scaler is None:
+            return 0
+        return self._scaler.process_due(self.clock.now())
+
+    def _round(self, label: str) -> List[Decision]:
+        """One kube-scheduler requeue pass: pending drivers oldest-first
+        (the queue order FIFO assumes), then pending executors."""
+        h = self.harness
+        decisions: List[Decision] = []
+        node_names = sorted(n.name for n in h.api.list(Node.KIND))
+        if not node_names:
+            return decisions
+
+        ig_label = h.server.install.instance_group_label
+
+        def attempt(pod: Pod, role: str) -> str:
+            t0 = time.perf_counter()
+            result = h.schedule(pod, node_names)
+            dt = time.perf_counter() - t0
+            self._latencies.append(dt)
+            h.server.metrics.histogram("sim.decision.latency", dt)
+            outcome = "success" if result.node_names else "failure"
+            if not result.node_names and result.failed_nodes:
+                # all failed_nodes share one message; surface its outcome class
+                msg = next(iter(result.failed_nodes.values()))
+                outcome = self._classify_failure(msg)
+            group = pod.node_affinity.get(ig_label) or [""]
+            decisions.append(
+                Decision(
+                    pod_name=pod.name,
+                    role=role,
+                    instance_group=group[0],
+                    created=pod.creation_timestamp,
+                    outcome=outcome,
+                    node=result.node_names[0] if result.node_names else "",
+                )
+            )
+            return outcome
+
+        pending_drivers = sorted(
+            (
+                p
+                for p in h.api.list(Pod.KIND)
+                if p.labels.get(L.SPARK_ROLE_LABEL) == L.DRIVER
+                and not p.node_name
+                and p.meta.deletion_timestamp is None
+            ),
+            key=lambda p: (p.creation_timestamp, p.name),
+        )
+        for driver in pending_drivers:
+            outcome = attempt(driver, "driver")
+            app = self._apps.get(driver.labels.get(L.SPARK_APP_ID_LABEL, ""))
+            if outcome == "success" and app is not None and app.state == "pending":
+                self._materialize_executors(app)
+
+        pending_executors = sorted(
+            (
+                p
+                for p in h.api.list(Pod.KIND)
+                if p.labels.get(L.SPARK_ROLE_LABEL) == L.EXECUTOR
+                and not p.node_name
+                and p.meta.deletion_timestamp is None
+            ),
+            key=lambda p: (p.creation_timestamp, p.name),
+        )
+        for executor in pending_executors:
+            attempt(executor, "executor")
+
+        self._check_completions()
+        return decisions
+
+    def _materialize_executors(self, app: _App) -> None:
+        """Driver bound → Spark starts requesting executors (fresh pods
+        stamped at the bind instant, not app arrival)."""
+        h = self.harness
+        app.state = "running"
+        spec = app.spec
+        count = spec.executor_count
+        if app.executor_template is None:
+            return
+        for i in range(count):
+            pod = app.executor_template.deepcopy()
+            pod.meta.name = f"{spec.app_id}-exec-{i + 1}"
+            pod.meta.creation_timestamp = self.clock.now()
+            pod.meta.resource_version = 0
+            pod.meta.uid = ""
+            pod.node_name = ""
+            h.create_pod(pod)
+            app.executor_names.append(pod.meta.name)
+
+    def _check_completions(self) -> None:
+        h = self.harness
+        for app_id in sorted(self._apps):
+            app = self._apps[app_id]
+            if app.state != "running" or app.completion_scheduled:
+                continue
+            driver = h.server.pod_informer.get(app.spec.namespace, app.driver_name)
+            if driver is None or not driver.node_name:
+                continue
+            bound = sum(
+                1
+                for name in app.executor_names
+                if (p := h.server.pod_informer.get(app.spec.namespace, name)) is not None
+                and p.node_name
+            )
+            need = app.spec.min_executor_count if app.spec.dynamic else app.spec.executor_count
+            if bound >= need:
+                app.completion_scheduled = True
+                self.clock.schedule_in(
+                    app.spec.lifetime,
+                    f"complete:{app_id}",
+                    lambda a=app_id: self._on_complete(a),
+                )
+
+    @staticmethod
+    def _classify_failure(message: str) -> str:
+        m = message.lower()
+        if "earlier" in m:
+            from ..scheduler.extender import FAILURE_EARLIER_DRIVER
+
+            return FAILURE_EARLIER_DRIVER
+        if "fit" in m or "capacity" in m or "reserve" in m:
+            return "failure-fit"
+        return "failure"
+
+    # -- audit + log ----------------------------------------------------------
+
+    def _process(self, label: str, decisions: List[Decision]) -> None:
+        """Quiesce → audit → append one event-log entry."""
+        self._quiesce(label)
+        self.auditor.check_round(decisions, label)
+        self.auditor.check_state(label)
+        self._schedule_scaler_pumps()
+        # one API listing per kind per event, shared by the depth gauge,
+        # the log entry, and the fingerprint (APIServer.list deepcopies
+        # every object — repeating it per consumer multiplied the sim's
+        # dominant per-event cost)
+        pods = self.harness.api.list(Pod.KIND)
+        nodes = self.harness.api.list(Node.KIND)
+        depth = sum(
+            1
+            for p in pods
+            if p.labels.get(L.SPARK_ROLE_LABEL) == L.DRIVER and not p.node_name
+        )
+        self._queue_depths.append(depth)
+        self.harness.server.metrics.gauge("sim.queue.depth", float(depth))
+        eff = self._packing_efficiency()
+        if eff is not None:
+            self._efficiencies.append(eff)
+        entry = {
+            "seq": self._seq,
+            "t": round(self.clock.now() - SIM_EPOCH, 6),
+            "event": label,
+            "decisions": [
+                {"pod": d.pod_name, "role": d.role, "outcome": d.outcome, "node": d.node}
+                for d in decisions
+            ],
+            "queue_depth": depth,
+            "nodes": len(nodes),
+            "state": self._state_fingerprint(pods, nodes),
+        }
+        if eff is not None:
+            entry["packing_efficiency"] = round(eff, 6)
+        self._seq += 1
+        self._log.append(entry)
+
+    def _audit_only(self, label: str) -> None:
+        self._quiesce(label)
+        self.auditor.check_state(label)
+        self._schedule_scaler_pumps()
+
+    def _quiesce(self, label: str) -> None:
+        h = self.harness
+        ok = h.wait_quiesced(timeout=30.0)
+        demand_cache = h.server.demand_cache
+        ok2 = h.wait_for_api(
+            lambda: not any(demand_cache.inflight_queue_lengths()), timeout=30.0
+        )
+        if not (ok and ok2):
+            self.auditor.violations.append(
+                f"Q0[{label}]: async write-back failed to quiesce"
+            )
+
+    def _schedule_scaler_pumps(self) -> None:
+        """Turn pending delayed demands into clock events at their due
+        instants (checked post-quiesce so the pending set is stable)."""
+        if self._scaler is None:
+            return
+        for due in self._scaler.due_times():
+            # each due instant gets exactly ONE pump event, ever (the set
+            # is never drained): zero-delay demands fire as the very next
+            # event (clock.schedule clamps past instants to now), and a
+            # capped demand whose due has passed waits for the next tick
+            # pump rather than respinning the same virtual instant
+            if due not in self._pumps_scheduled:
+                self._pumps_scheduled.add(due)
+                self.clock.schedule(due, "scale-up", lambda d=due: self._on_scaler_pump(d))
+
+    def _packing_efficiency(self) -> Optional[float]:
+        """Mean over occupied nodes of the max-dimension
+        reserved/allocatable ratio (hard + soft reservations) — the
+        sim-level packing signal the summary reports."""
+        h = self.harness
+        usage = usage_for_nodes(h.server.resource_reservation_cache.list())
+        for node, res in h.server.soft_reservation_store.used_soft_reservation_resources().items():
+            usage[node] = usage.get(node, Resources.zero()).add(res)
+        nodes = {n.name: n for n in h.server.node_informer.list()}
+        ratios = []
+        for name, used in sorted(usage.items()):
+            node = nodes.get(name)
+            if node is None:
+                continue
+            dims = []
+            for dim in ("cpu", "memory", "nvidia_gpu"):
+                alloc = getattr(node.allocatable, dim).exact
+                if alloc > 0:
+                    dims.append(float(getattr(used, dim).exact / alloc))
+            if dims:
+                ratios.append(max(dims))
+        if not ratios:
+            return None
+        return sum(ratios) / len(ratios)
+
+    def _state_fingerprint(self, pods: List[Pod], nodes: List[Node]) -> str:
+        """SHA-256 over the canonical serialization of every
+        scheduling-relevant field of quiesced cluster state."""
+        api = self.harness.api
+        soft = self.harness.server.soft_reservation_store.get_all_soft_reservations_copy()
+        state = {
+            "nodes": sorted(
+                [
+                    n.name,
+                    sorted(n.labels.items()),
+                    [str(n.allocatable.cpu.exact), str(n.allocatable.memory.exact), str(n.allocatable.nvidia_gpu.exact)],
+                    bool(n.unschedulable),
+                    bool(n.ready),
+                ]
+                for n in nodes
+            ),
+            "pods": sorted(
+                [p.namespace, p.name, p.labels.get(L.SPARK_ROLE_LABEL, ""), p.node_name, p.phase]
+                for p in pods
+            ),
+            "reservations": sorted(
+                [
+                    rr.namespace,
+                    rr.name,
+                    sorted((k, v.node) for k, v in rr.spec.reservations.items()),
+                    sorted(rr.status.pods.items()),
+                ]
+                for rr in api.list(ResourceReservation.KIND)
+            ),
+            "soft": sorted(
+                [app_id, sorted((name, r.node) for name, r in sr.reservations.items()),
+                 sorted(sr.status.items())]
+                for app_id, sr in soft.items()
+            ),
+            "demands": sorted(
+                [
+                    d.namespace,
+                    d.name,
+                    d.status.phase,
+                    [[str(u.resources.cpu.exact), str(u.resources.memory.exact), u.count] for u in d.spec.units],
+                ]
+                for d in api.list(Demand.KIND)
+            ),
+        }
+        blob = json.dumps(state, sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    # -- results --------------------------------------------------------------
+
+    def _result(self, wall_s: float) -> SimulationResult:
+        blob = "\n".join(
+            json.dumps(e, sort_keys=True, separators=(",", ":")) for e in self._log
+        )
+        digest = hashlib.sha256(blob.encode()).hexdigest()
+        lat = sorted(self._latencies)
+
+        def pct(q: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(q * len(lat)))] * 1000.0
+
+        states = [a.state for a in self._apps.values()]
+        summary = {
+            "scenario": self.scenario.name,
+            "seed": self.scenario.seed,
+            "sim_duration_s": self.scenario.duration,
+            "wall_duration_s": round(wall_s, 3),
+            "sim_speedup": round(self.scenario.duration / wall_s, 1) if wall_s > 0 else None,
+            "events_logged": len(self._log),
+            "events_audited": self.auditor.events_audited if self.auditor else 0,
+            "decisions": len(self._latencies),
+            "decisions_per_sec_wall": round(len(self._latencies) / wall_s, 1) if wall_s > 0 else None,
+            "decision_latency_ms": {
+                "p50": round(pct(0.50), 3),
+                "p95": round(pct(0.95), 3),
+                "p99": round(pct(0.99), 3),
+                "max": round(lat[-1] * 1000.0, 3) if lat else 0.0,
+            },
+            "apps": {
+                "arrived": len(self._apps),
+                "completed": states.count("done"),
+                "running_at_end": states.count("running"),
+                "pending_at_end": states.count("pending"),
+                "killed": states.count("dead"),
+            },
+            "queue_depth": {
+                "max": max(self._queue_depths, default=0),
+                "mean": round(sum(self._queue_depths) / len(self._queue_depths), 2)
+                if self._queue_depths
+                else 0.0,
+                "final": self._queue_depths[-1] if self._queue_depths else 0,
+            },
+            "packing_efficiency": {
+                "mean": round(sum(self._efficiencies) / len(self._efficiencies), 4)
+                if self._efficiencies
+                else None,
+                "final": round(self._efficiencies[-1], 4) if self._efficiencies else None,
+            },
+            "nodes": {
+                "initial": self.scenario.cluster.nodes,
+                "scaled_up": self._scaler.created_nodes if self._scaler else 0,
+                "killed": self._killed_nodes,
+                "capped_demands": len(self._scaler.capped) if self._scaler else 0,
+            },
+            "invariant_violations": len(self.auditor.violations) if self.auditor else -1,
+            "digest": digest,
+        }
+        return SimulationResult(
+            digest=digest,
+            summary=summary,
+            event_log=self._log,
+            violations=list(self.auditor.violations) if self.auditor else [],
+        )

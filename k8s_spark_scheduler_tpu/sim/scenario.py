@@ -1,0 +1,129 @@
+"""Declarative scenario spec: cluster shape + workload + faults.
+
+A scenario is a plain dict (usually a JSON file under ``examples/sim/``)
+so runs are reviewable, diffable artifacts:
+
+.. code-block:: json
+
+    {
+      "name": "chaos",
+      "seed": 42,
+      "duration": 1800,
+      "retry_interval": 15,
+      "cluster": {"nodes": 8, "cpu": "16", "memory": "32Gi",
+                  "zones": ["zone1", "zone2"]},
+      "binpack_algo": "tightly-pack",
+      "fifo": true,
+      "workload": {"process": "poisson", "rate_per_min": 2,
+                   "executors": {"min": 1, "max": 6},
+                   "dynamic_fraction": 0.3,
+                   "lifetime": {"min": 120, "max": 600}},
+      "autoscaler": {"enabled": true, "delay": 45, "max_nodes": 24},
+      "faults": [
+        {"at": 600, "kind": "node_kill", "count": 2},
+        {"at": 800, "kind": "node_cordon", "count": 1},
+        {"at": 1000, "kind": "executor_storm", "apps": 2},
+        {"at": 1200, "kind": "failover"}
+      ]
+    }
+
+Fault catalog (all deterministic under the scenario seed):
+
+- ``node_kill``: delete ``count`` nodes (oldest scaled-up last); pods
+  bound there die — the driver's death tears the whole app down via
+  owner GC, executor deaths leave unbound reservations that replacement
+  executors must re-claim;
+- ``node_cordon`` / ``node_uncordon``: flip ``unschedulable`` on
+  ``count`` nodes;
+- ``executor_storm``: kill ``fraction`` of bound executors across up to
+  ``apps`` applications simultaneously and submit replacements — the
+  soft-reservation tombstone race;
+- ``failover``: wipe the (intentionally unpersisted) soft-reservation
+  store and run ``scheduler/failover.py`` reconciliation, as a fresh
+  leader would.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+FAULT_KINDS = {"node_kill", "node_cordon", "node_uncordon", "executor_storm", "failover"}
+
+
+@dataclass
+class ClusterSpec:
+    nodes: int = 4
+    cpu: str = "16"
+    memory: str = "32Gi"
+    gpu: str = "0"
+    zones: List[str] = field(default_factory=lambda: ["zone1"])
+    instance_group: str = "batch-medium-priority"
+
+
+@dataclass
+class AutoscalerSpec:
+    enabled: bool = False
+    delay: float = 0.0
+    max_nodes: Optional[int] = None
+    node_cpu: str = "16"
+    node_memory: str = "32Gi"
+    node_gpu: str = "0"
+
+
+@dataclass
+class FaultSpec:
+    at: float
+    kind: str
+    count: int = 1
+    apps: int = 1
+    fraction: float = 0.5
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; known: {sorted(FAULT_KINDS)}")
+
+
+@dataclass
+class Scenario:
+    name: str = "scenario"
+    seed: int = 0
+    duration: float = 600.0
+    # how often pending pods are retried (kube-scheduler's backoff
+    # analog) and the autoscaler pump granularity, virtual seconds
+    retry_interval: float = 15.0
+    binpack_algo: str = "tightly-pack"
+    fifo: bool = True
+    cluster: ClusterSpec = field(default_factory=ClusterSpec)
+    workload: Dict = field(default_factory=dict)
+    autoscaler: AutoscalerSpec = field(default_factory=AutoscalerSpec)
+    faults: List[FaultSpec] = field(default_factory=list)
+    # deterministic unschedulable-marker sweeps (0 disables)
+    unschedulable_scan_interval: float = 0.0
+
+    @staticmethod
+    def from_dict(d: Dict) -> "Scenario":
+        d = dict(d)
+        unknown = set(d) - {
+            "name", "seed", "duration", "retry_interval", "binpack_algo",
+            "fifo", "cluster", "workload", "autoscaler", "faults",
+            "unschedulable_scan_interval",
+        }
+        if unknown:
+            raise ValueError(f"unknown scenario keys: {sorted(unknown)}")
+        cluster = ClusterSpec(**d.pop("cluster", {}))
+        autoscaler = AutoscalerSpec(**d.pop("autoscaler", {}))
+        faults = [FaultSpec(**f) for f in d.pop("faults", [])]
+        faults.sort(key=lambda f: (f.at, f.kind))
+        return Scenario(cluster=cluster, autoscaler=autoscaler, faults=faults, **d)
+
+    @staticmethod
+    def from_file(path: str) -> "Scenario":
+        with open(path) as f:
+            return Scenario.from_dict(json.load(f))
+
+    def to_dict(self) -> Dict:
+        from dataclasses import asdict
+
+        return asdict(self)

@@ -10,10 +10,10 @@ pods and instance-group affinity.
 
 from __future__ import annotations
 
-import itertools
 import time
 from typing import List, Optional, Sequence
 
+from .. import timesource
 from ..config import FifoConfig, Install
 from ..kube.apiserver import APIServer
 from ..kube.crd import DEMAND_CRD_NAME, demand_crd_spec
@@ -22,8 +22,6 @@ from ..server.wiring import Server, init_server_with_clients
 from ..types.extenderapi import ExtenderArgs, ExtenderFilterResult
 from ..types.objects import Container, Node, ObjectMeta, Pod, PodPhase
 from ..types.resources import ZONE_LABEL, Resources
-
-_counter = itertools.count(1)
 
 
 class Harness:
@@ -40,6 +38,7 @@ class Harness:
         extra_install: Optional[Install] = None,
         driver_prioritized_node_label=None,
         executor_prioritized_node_label=None,
+        unschedulable_polling_interval: float = 60.0,
     ):
         self.api = APIServer()
         if with_demand_crd:
@@ -54,7 +53,11 @@ class Harness:
             executor_prioritized_node_label=executor_prioritized_node_label,
         )
         self.server: Server = init_server_with_clients(
-            self.api, install, start_background=True, demand_poll_interval=0.02
+            self.api,
+            install,
+            start_background=True,
+            demand_poll_interval=0.02,
+            unschedulable_polling_interval=unschedulable_polling_interval,
         )
         self.extender = self.server.extender
         self.unschedulable_marker = self.server.unschedulable_marker
@@ -185,7 +188,7 @@ class Harness:
         namespace: str,
         creation_timestamp: Optional[float],
     ) -> List[Pod]:
-        ts = creation_timestamp if creation_timestamp is not None else time.time()
+        ts = creation_timestamp if creation_timestamp is not None else timesource.now()
         driver = Pod(
             meta=ObjectMeta(
                 name=f"{app_id}-driver",
@@ -294,9 +297,13 @@ class Harness:
         return self.wait_for_api(settled, timeout=timeout)
 
     def wait_for_api(self, cond, timeout: float = 5.0, tick: float = 0.01) -> bool:
-        """waitForCondition (cmd/integration common.go:119-136)."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        """waitForCondition (cmd/integration common.go:119-136).
+
+        Deadline on the REAL monotonic clock, never the (possibly
+        virtual, frozen) timesource — a sim run must keep bounded
+        waits bounded."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if cond():
                 return True
             time.sleep(tick)

@@ -1,0 +1,49 @@
+"""Pluggable wall-clock source for the control plane.
+
+Every *semantic* "what time is it" read in the scheduler — object
+creation timestamps, the failover idle-reconcile trigger, FIFO
+enforce-after ages, demand-waste attribution, the unschedulable-pod
+timeout — goes through :func:`now` instead of ``time.time``.  In
+production it IS ``time.time``; the discrete-event simulator
+(:mod:`k8s_spark_scheduler_tpu.sim`) swaps in a virtual clock so hours
+of cluster life replay in milliseconds and timers fire at simulated
+instants, deterministically.
+
+Latency *measurement* (``perf_counter`` spans, histograms) and
+harness/infrastructure deadlines (``time.monotonic`` waits) are
+intentionally NOT routed through here: a sim run still wants real
+decision latencies, and a frozen virtual clock must never turn a
+bounded wait into an infinite one.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+_source: Callable[[], float] = time.time
+
+
+def now() -> float:
+    """Current semantic wall-clock time (seconds since epoch, or
+    virtual seconds when a simulator clock is installed)."""
+    return _source()
+
+
+def set_source(fn: Callable[[], float]) -> None:
+    """Install a replacement time source (e.g. a VirtualClock's
+    ``now``).  Affects every thread in the process — callers own the
+    responsibility to :func:`reset` when done (the sim runner does this
+    in a ``finally``)."""
+    global _source
+    _source = fn
+
+
+def reset() -> None:
+    """Restore the real wall clock."""
+    global _source
+    _source = time.time
+
+
+def is_virtual() -> bool:
+    return _source is not time.time

@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import copy as _copy
 import itertools
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from .. import timesource
 from ..utils.quantity import Quantity
 from .resources import (
     RESOURCE_CPU,
@@ -28,7 +28,7 @@ _monotonic_counter = itertools.count(1)
 
 
 def now() -> float:
-    return time.time()
+    return timesource.now()
 
 
 @dataclass

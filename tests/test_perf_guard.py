@@ -142,6 +142,37 @@ def test_span_tree_overhead_budget():
     )
 
 
+# -- simulator throughput guard ----------------------------------------------
+#
+# The discrete-event simulator is the load/soak/chaos evidence layer for
+# every later perf PR, so its own overhead (quiesce polling, per-event
+# auditing, state fingerprinting) must not silently regress.  Budget is
+# simulated scheduling decisions per wall-clock second on CPU over the
+# bundled smoke scenario; measured ~140-150/s on the dev host, so the
+# default bound leaves ~5x margin for slower CI hosts
+# (override via SIM_MIN_DECISIONS_PER_SEC).
+
+SIM_MIN_DECISIONS_PER_SEC = float(os.environ.get("SIM_MIN_DECISIONS_PER_SEC", "25"))
+
+
+def test_sim_throughput_budget():
+    from k8s_spark_scheduler_tpu.sim import Scenario, Simulation
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sc = Scenario.from_file(os.path.join(here, "examples", "sim", "smoke.json"))
+    result = Simulation(sc).run()
+    assert result.violations == []
+    rate = result.summary["decisions_per_sec_wall"]
+    assert rate is not None and rate >= SIM_MIN_DECISIONS_PER_SEC, (
+        f"simulator throughput regression: {rate} simulated scheduling "
+        f"decisions/sec (budget {SIM_MIN_DECISIONS_PER_SEC}/s); "
+        f"{result.summary['decisions']} decisions in "
+        f"{result.summary['wall_duration_s']}s wall"
+    )
+    # the virtual clock must buy real compression: ≥20x sim over wall
+    assert result.summary["sim_speedup"] >= 20.0
+
+
 def test_predicate_latency_with_tracing_within_budget():
     from k8s_spark_scheduler_tpu.testing.harness import Harness
 
