@@ -32,6 +32,49 @@ class AsyncClientConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Overload protection / degraded mode (resilience/).
+
+    ``request_deadline_seconds`` mirrors kube-scheduler's extender
+    ``httpTimeout`` (examples/extender.yml: 30s); the server answers
+    fail-fast ``deadline_margin_seconds`` before the caller hangs up.
+    """
+
+    request_deadline_seconds: float = 30.0
+    deadline_margin_seconds: float = 1.0
+    # concurrent /predicates requests admitted (holding + queued on the
+    # extender lock) before excess requests are shed with a retriable
+    # failure
+    admission_max_waiters: int = 16
+    # consecutive API-server write failures before the write-back
+    # breaker opens and diverts reservation writes to the intent journal
+    breaker_failure_threshold: int = 5
+    breaker_cooloff_seconds: float = 30.0
+    # durable JSONL intent journal; None keeps intents in memory only
+    # (still replayed on in-process recovery, lost on process death)
+    journal_path: Optional[str] = None
+    # consecutive kernel-lane failures (or over-budget successes) before
+    # the lane is demoted to the host/native path
+    lane_failure_threshold: int = 3
+    lane_cooloff_seconds: float = 60.0
+    lane_latency_budget_seconds: Optional[float] = 5.0
+
+    @staticmethod
+    def from_dict(d: dict) -> "ResilienceConfig":
+        return ResilienceConfig(
+            request_deadline_seconds=d.get("request-deadline-seconds", 30.0),
+            deadline_margin_seconds=d.get("deadline-margin-seconds", 1.0),
+            admission_max_waiters=d.get("admission-max-waiters", 16),
+            breaker_failure_threshold=d.get("breaker-failure-threshold", 5),
+            breaker_cooloff_seconds=d.get("breaker-cooloff-seconds", 30.0),
+            journal_path=d.get("journal-path"),
+            lane_failure_threshold=d.get("lane-failure-threshold", 3),
+            lane_cooloff_seconds=d.get("lane-cooloff-seconds", 60.0),
+            lane_latency_budget_seconds=d.get("lane-latency-budget-seconds", 5.0),
+        )
+
+
+@dataclass
 class ConversionWebhookConfig:
     """Where the apiserver reaches the CRD conversion webhook (the
     reference wires this from the witchcraft server's service identity,
@@ -63,6 +106,7 @@ class Install:
     executor_prioritized_node_label: Optional[LabelPriorityOrder] = None
     resource_reservation_crd_annotations: Dict[str, str] = field(default_factory=dict)
     conversion_webhook: Optional[ConversionWebhookConfig] = None
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     # replicate the reference's accidental-but-load-bearing behaviors
     # (see compat.py for the list); off = corrected semantics
     strict_reference_parity: bool = compat.DEFAULT_STRICT
@@ -135,4 +179,5 @@ class Install:
             strict_reference_parity=d.get(
                 "strict-reference-parity", compat.DEFAULT_STRICT
             ),
+            resilience=ResilienceConfig.from_dict(d.get("resilience", {})),
         )

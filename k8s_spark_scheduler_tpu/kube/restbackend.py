@@ -43,6 +43,25 @@ WatchHandler = Callable[[str, APIObject], None]
 
 CRD_BASE = "/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
 
+# watch-reconnect backoff: full jitter over an exponentially-growing
+# window, capped.  Full jitter (AWS architecture blog shape) desynchronizes
+# a fleet of watchers hammering a recovering API server; both error paths
+# (stream drop AND relist failure) MUST draw from the same distribution —
+# a jitterless path re-synchronizes the herd on exactly the retries that
+# matter most.
+WATCH_BACKOFF_INITIAL_S = 0.2
+WATCH_BACKOFF_CAP_S = 30.0
+
+
+def watch_backoff_delay(backoff: float, rng=random) -> float:
+    """One full-jitter delay draw: uniform over [0, min(backoff, cap)]."""
+    return rng.uniform(0.0, min(backoff, WATCH_BACKOFF_CAP_S))
+
+
+def next_watch_backoff(backoff: float) -> float:
+    """The window for the NEXT retry: doubled, capped."""
+    return min(backoff * 2, WATCH_BACKOFF_CAP_S)
+
 
 @dataclass
 class _Resource:
@@ -186,7 +205,7 @@ class _KindWatch:
                 logger.exception("watch handler failed for %s", self.resource.kind)
 
     def _run(self) -> None:
-        backoff = 0.2
+        backoff = WATCH_BACKOFF_INITIAL_S
         while not self.stop_event.is_set():
             try:
                 for etype, wire in self.backend.client.watch(
@@ -194,7 +213,7 @@ class _KindWatch:
                     self.resource_version,
                     stop=self.stop_event,
                 ):
-                    backoff = 0.2
+                    backoff = WATCH_BACKOFF_INITIAL_S
                     if etype == "BOOKMARK":
                         rv = (wire.get("metadata") or {}).get("resourceVersion")
                         if rv:
@@ -213,16 +232,16 @@ class _KindWatch:
                     self._relist_and_diff()
                 except Exception:
                     logger.exception("relist after 410 failed; backing off")
-                    self.stop_event.wait(backoff)
-                    backoff = min(backoff * 2, 30.0)
+                    self.stop_event.wait(watch_backoff_delay(backoff))
+                    backoff = next_watch_backoff(backoff)
             except Exception:
                 if self.stop_event.is_set():
                     return
                 logger.exception(
                     "watch stream for %s dropped; reconnecting", self.resource.kind
                 )
-                self.stop_event.wait(backoff + random.uniform(0, backoff))
-                backoff = min(backoff * 2, 30.0)
+                self.stop_event.wait(watch_backoff_delay(backoff))
+                backoff = next_watch_backoff(backoff)
 
     def _relist_and_diff(self) -> None:
         with self.lock:

@@ -280,7 +280,12 @@ class RestClient:
             path = f"{path}?{urlencode(params)}"
         mutating = method in ("POST", "PUT", "PATCH", "DELETE")
         if self._bucket is not None and mutating:
-            self._bucket.acquire()
+            # bound the token wait by the propagated request deadline:
+            # a write that cannot be sent in time fails retriably
+            # instead of blocking past the caller
+            from .ratelimit import acquire_within_deadline
+
+            acquire_within_deadline(self._bucket)
         payload = json.dumps(body).encode() if body is not None else None
         # GETs are idempotent: one silent retry on a stale keep-alive
         # conn.  Mutations get a pre-emptively fresh connection instead

@@ -61,6 +61,27 @@ KERNEL_JIT_CACHE_SIZE = "foundry.spark.scheduler.tpu.kernel.jit.cache.size"
 # per-span duration distributions (tracing/spans.py), tagged span=
 TRACE_SPAN_TIME = "foundry.spark.scheduler.trace.span.time"
 
+# resilience layer (resilience/): overload protection + degraded mode
+RESILIENCE_SHED_COUNT = "foundry.spark.scheduler.resilience.shed.count"
+RESILIENCE_DEADLINE_EXPIRED_COUNT = (
+    "foundry.spark.scheduler.resilience.deadline.expired.count"
+)
+RESILIENCE_BREAKER_STATE = "foundry.spark.scheduler.resilience.breaker.state"
+RESILIENCE_BREAKER_TRANSITIONS = (
+    "foundry.spark.scheduler.resilience.breaker.transitions.count"
+)
+RESILIENCE_JOURNAL_DEPTH = "foundry.spark.scheduler.resilience.journal.depth"
+RESILIENCE_JOURNAL_APPENDED = (
+    "foundry.spark.scheduler.resilience.journal.appended.count"
+)
+RESILIENCE_JOURNAL_REPLAYED = (
+    "foundry.spark.scheduler.resilience.journal.replayed.count"
+)
+RESILIENCE_LANE_DEMOTIONS = "foundry.spark.scheduler.resilience.lane.demotion.count"
+RESILIENCE_LANE_STATE = "foundry.spark.scheduler.resilience.lane.state"
+RESILIENCE_HEALTH_STATE = "foundry.spark.scheduler.resilience.health.state"
+RESILIENCE_GATE_INFLIGHT = "foundry.spark.scheduler.resilience.gate.inflight"
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"

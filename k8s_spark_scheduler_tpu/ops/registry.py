@@ -134,6 +134,32 @@ def select_binpacker(
     return _REGISTRY.get(name, _REGISTRY[DEFAULT])
 
 
+# -- kernel chaos hook --------------------------------------------------------
+#
+# The simulator's kernel_fault injection point: when armed, every device
+# lane entry (tensor driver path, device FIFO solve, tensor reschedule)
+# raises through the extender's REAL exception-fallback path, so lane
+# demotion/re-probe (resilience/lanehealth.py) is exercised against the
+# same control flow production faults take.  None (the default) costs one
+# module-attribute read per dispatch.
+
+_kernel_fault_hook = None
+
+
+def set_kernel_fault_hook(fn) -> None:
+    """fn(lane_name) -> Optional[Exception]; None disarms."""
+    global _kernel_fault_hook
+    _kernel_fault_hook = fn
+
+
+def check_kernel_fault(lane: str) -> None:
+    fn = _kernel_fault_hook
+    if fn is not None:
+        err = fn(lane)
+        if err is not None:
+            raise err
+
+
 def available_binpackers() -> list[str]:
     return sorted(
         _REGISTRY.keys()

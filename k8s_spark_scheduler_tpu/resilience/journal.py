@@ -1,0 +1,175 @@
+"""Durable intent journal for diverted write-back requests.
+
+When the write-back circuit breaker opens (or a request exhausts its
+retries), the reservation write is *diverted* here instead of being
+dropped: the intent — operation, key, and the object's wire form — is
+appended to a JSONL file (or kept in memory when no path is configured)
+and replayed idempotently once the API server recovers, or by the next
+scheduler instance on failover.
+
+File format: one JSON object per line, append-only while running.
+
+- ``{"a": "put", "seq": N, "op": "create|update|delete", "kind": …,
+  "ns": …, "name": …, "obj": {…wire…}}`` — a pending intent; the latest
+  put per (ns, name) wins (an app created then deleted during an outage
+  nets out to the delete).
+- ``{"a": "ack", "seq": N}`` — the intent landed at the API server.
+
+Loading compacts: pending intents are puts without an ack, newest per
+key.  Exactly-once at the CRD level comes from replaying through the
+idempotent write path (create → AlreadyExists folds the server copy;
+delete → NotFound is success), not from the journal itself.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+Key = Tuple[str, str]  # (namespace, name)
+
+# create/update collapse to one ack class: both assert "the store's
+# content for this key is now at the server", and the queue already
+# dedupes them per key
+_UPSERT = "upsert"
+
+
+def _op_class(op: str) -> str:
+    return "delete" if op == "delete" else _UPSERT
+
+
+class IntentJournal:
+    def __init__(self, path: Optional[str] = None, metrics=None):
+        self._path = path
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._seq = 0
+        # key → intent dict (latest wins)
+        self._pending: Dict[Key, dict] = {}
+        self._fh = None
+        if path:
+            self._load()
+
+    # -- persistence ---------------------------------------------------------
+
+    def _load(self) -> None:
+        pending: Dict[Key, dict] = {}
+        by_seq: Dict[int, Key] = {}
+        max_seq = 0
+        if os.path.exists(self._path):
+            with open(self._path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        logger.warning("skipping corrupt journal line")
+                        continue
+                    seq = int(rec.get("seq", 0))
+                    max_seq = max(max_seq, seq)
+                    if rec.get("a") == "put":
+                        key = (rec.get("ns", ""), rec.get("name", ""))
+                        pending[key] = rec
+                        by_seq[seq] = key
+                    elif rec.get("a") == "ack":
+                        key = by_seq.get(seq)
+                        if key is not None and pending.get(key, {}).get("seq") == seq:
+                            pending.pop(key, None)
+        self._pending = pending
+        self._seq = max_seq
+        # compact: rewrite only the still-pending intents so the file
+        # doesn't grow across restarts
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in pending.values():
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        os.replace(tmp, self._path)
+        self._fh = open(self._path, "a")
+        self._report_depth()
+
+    def _append_line(self, rec: dict) -> None:
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fh.flush()
+
+    # -- recording -----------------------------------------------------------
+
+    def record(
+        self, op: str, kind: str, namespace: str, name: str, obj_wire: Optional[dict]
+    ) -> None:
+        """Divert one write intent (latest-wins per key)."""
+        with self._lock:
+            self._seq += 1
+            rec = {
+                "a": "put",
+                "seq": self._seq,
+                "op": op,
+                "kind": kind,
+                "ns": namespace,
+                "name": name,
+                "obj": obj_wire,
+            }
+            self._pending[(namespace, name)] = rec
+            self._append_line(rec)
+            self._report_depth()
+            if self._metrics is not None:
+                from ..metrics import names as mnames
+
+                self._metrics.counter(
+                    mnames.RESILIENCE_JOURNAL_APPENDED, {"op": op, "kind": kind}
+                )
+
+    def ack(self, op: str, namespace: str, name: str) -> bool:
+        """Mark the pending intent for a key as landed.  Only acks when
+        the landed operation's class matches the pending intent's (an
+        upsert landing must not ack a newer pending delete)."""
+        with self._lock:
+            key = (namespace, name)
+            rec = self._pending.get(key)
+            if rec is None or _op_class(rec["op"]) != _op_class(op):
+                return False
+            del self._pending[key]
+            self._append_line({"a": "ack", "seq": rec["seq"]})
+            self._report_depth()
+            if self._metrics is not None:
+                from ..metrics import names as mnames
+
+                self._metrics.counter(mnames.RESILIENCE_JOURNAL_REPLAYED)
+            return True
+
+    # -- introspection -------------------------------------------------------
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def pending(self) -> List[dict]:
+        """Copies of pending intents in seq order."""
+        with self._lock:
+            return sorted((dict(r) for r in self._pending.values()), key=lambda r: r["seq"])
+
+    def pending_keys(self) -> Set[Key]:
+        with self._lock:
+            return set(self._pending)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def _report_depth(self) -> None:
+        # caller holds the lock
+        if self._metrics is not None:
+            from ..metrics import names as mnames
+
+            self._metrics.gauge(
+                mnames.RESILIENCE_JOURNAL_DEPTH, float(len(self._pending))
+            )

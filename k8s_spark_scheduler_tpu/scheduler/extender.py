@@ -29,7 +29,8 @@ from ..metrics.registry import MetricsRegistry, default_registry
 from ..ops import capacity as cap
 from ..ops.efficiency import compute_avg_packing_efficiency
 from ..ops.nodesort import NodeSorter
-from ..ops.registry import SINGLE_AZ_MINIMAL_FRAGMENTATION, Binpacker
+from ..ops.registry import SINGLE_AZ_MINIMAL_FRAGMENTATION, Binpacker, check_kernel_fault
+from ..resilience import deadline as req_deadline
 from ..types.extenderapi import ExtenderArgs, ExtenderFilterResult
 from ..types.objects import Node, Pod
 from ..types.resources import (
@@ -58,6 +59,10 @@ FAILURE_INTERNAL = "failure-internal"
 FAILURE_FIT = "failure-fit"
 FAILURE_EARLIER_DRIVER = "failure-earlier-driver"
 FAILURE_NON_SPARK_POD = "failure-non-spark-pod"
+# the request outlived its caller's httpTimeout: answer fail-fast so the
+# extender lock serves callers that are still listening (retriable — the
+# next kube-scheduler attempt gets a fresh deadline)
+FAILURE_DEADLINE = "failure-deadline-exceeded"
 SUCCESS = "success"
 SUCCESS_RESCHEDULED = "success-rescheduled"
 SUCCESS_ALREADY_BOUND = "success-already-bound"
@@ -103,6 +108,7 @@ class SparkSchedulerExtender:
         tensor_snapshot_cache=None,
         strict_reference_parity: bool = compat.DEFAULT_STRICT,
         tracer: Optional[tracing.Tracer] = None,
+        resilience=None,
     ):
         self._node_informer = node_informer
         self._pod_lister = pod_lister
@@ -132,6 +138,8 @@ class SparkSchedulerExtender:
         self._predicate_lock = threading.Lock()
         self._fast_path_ok = tensor_snapshot_cache is not None
         self._strict_reference_parity = strict_reference_parity
+        self._resilience = resilience
+        self._lane_health = resilience.lanes if resilience is not None else None
         self._last_request = 0.0
         # diagnostics: which lane served the last executor reschedule
         self.last_reschedule_path: Optional[str] = None
@@ -148,7 +156,36 @@ class SparkSchedulerExtender:
                 "predicate",
                 {"pod": args.pod.name, "namespace": args.pod.namespace},
             ):
+                # the request may have queued behind slow decisions for
+                # its whole deadline; answer fail-fast rather than spend
+                # the lock on a caller that already hung up
+                try:
+                    self._check_deadline("lock-acquired")
+                except SchedulingFailure as err:
+                    tracing.add_tag("outcome", err.outcome)
+                    return self._fail_with_message(err.outcome, args, str(err))
                 return self._predicate_locked(args)
+
+    def _lane_neutral(self, lane: str):
+        """A device lane declined the request (unsupported shape, inexact
+        snapshot) — neither success nor failure.  Release a possible
+        re-probe slot so a demoted lane can't wedge on neutral attempts."""
+        if self._lane_health is not None:
+            self._lane_health.release_probe(lane)
+        return None
+
+    def _check_deadline(self, phase: str) -> None:
+        """Phase-boundary deadline check (resilience/deadline.py): one
+        contextvar read when no deadline is bound."""
+        try:
+            req_deadline.check(phase)
+        except req_deadline.DeadlineExceeded as err:
+            from ..metrics import names as mnames
+
+            self._metrics.counter(
+                mnames.RESILIENCE_DEADLINE_EXPIRED_COUNT, {"phase": phase}
+            )
+            raise SchedulingFailure(FAILURE_DEADLINE, str(err))
 
     def _predicate_locked(self, args: ExtenderArgs) -> ExtenderFilterResult:
         pod = args.pod
@@ -351,6 +388,7 @@ class SparkSchedulerExtender:
         app_resources = app_resources_early
 
         packing_result = None
+        self._check_deadline("fifo-gate")
         if self._is_fifo:
             queued_drivers = self._pod_lister.list_earlier_drivers(driver)
             # tpu-batch: the whole earlier-drivers pass plus this driver's
@@ -382,6 +420,7 @@ class SparkSchedulerExtender:
                 )
 
         if packing_result is None:
+            self._check_deadline("binpack")
             with self._tracer.span(
                 "binpack", {"policy": self.binpacker.name, "lane": "host"}
             ) as sp:
@@ -409,6 +448,7 @@ class SparkSchedulerExtender:
     ) -> Tuple[str, str]:
         """Common driver-path tail: demand lifecycle, metrics, reservation
         creation (resource.go:347-369)."""
+        self._check_deadline("reservation-writeback")
         if not packing_result.has_capacity:
             self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
             raise SchedulingFailure(FAILURE_FIT, "application does not fit to the cluster")
@@ -457,7 +497,13 @@ class SparkSchedulerExtender:
             or not self._fast_path_ok
         ):
             return None
+        if self._lane_health is not None and not self._lane_health.allow(
+            "tensor_driver"
+        ):
+            return None  # demoted: host path serves until the re-probe
+        t0 = time.perf_counter()
         try:
+            check_kernel_fault("tensor_driver")
             from ..ops.fast_path import build_cluster_tensor
             from ..ops.sparkapp import AppDemand
 
@@ -472,7 +518,7 @@ class SparkSchedulerExtender:
                 )
                 sp.tag("exact", built is not None)
             if built is None:
-                return None
+                return self._lane_neutral("tensor_driver")
             cluster, zones = built
 
             earlier_apps = []
@@ -503,9 +549,15 @@ class SparkSchedulerExtender:
                 ),
             )
             if not outcome.supported:
-                return None
+                return self._lane_neutral("tensor_driver")
+            if self._lane_health is not None:
+                self._lane_health.record_success(
+                    "tensor_driver", time.perf_counter() - t0
+                )
             return outcome, zones
         except Exception:
+            if self._lane_health is not None:
+                self._lane_health.record_failure("tensor_driver")
             logger.exception("tensor-snapshot fast path failed; using Quantity path")
             return None
 
@@ -524,6 +576,10 @@ class SparkSchedulerExtender:
         solver = getattr(self.binpacker, "queue_solver", None)
         if solver is None:
             return None
+        if self._lane_health is not None and not self._lane_health.allow(
+            "device_fifo"
+        ):
+            return None  # demoted: the host earlier-drivers loop serves
         from ..ops.sparkapp import AppDemand
 
         earlier_apps = []
@@ -539,7 +595,9 @@ class SparkSchedulerExtender:
                 continue
             earlier_apps.append(demand)
             skip_allowed.append(queued.creation_timestamp > skip_cutoff)
+        t0 = time.perf_counter()
         try:
+            check_kernel_fault("device_fifo")
             outcome = solver.solve(
                 metadata,
                 driver_node_names,
@@ -560,8 +618,14 @@ class SparkSchedulerExtender:
                 self._metrics.counter(
                     "foundry.spark.scheduler.tpu.singleaz.lane", {"lane": lane}
                 )
+            if self._lane_health is not None:
+                self._lane_health.record_success(
+                    "device_fifo", time.perf_counter() - t0
+                )
             return outcome
         except Exception:
+            if self._lane_health is not None:
+                self._lane_health.record_failure("device_fifo")
             logger.exception("device FIFO solve failed; falling back to host loop")
             return None
 
@@ -843,12 +907,30 @@ class SparkSchedulerExtender:
         self.last_reschedule_path = "slow"
         if self._tensor_snapshot is None or not self._fast_path_ok:
             return None
+        if self._lane_health is not None and not self._lane_health.allow(
+            "tensor_reschedule"
+        ):
+            return None  # demoted: the Quantity path serves until the re-probe
+        t0 = time.perf_counter()
         try:
+            check_kernel_fault("tensor_reschedule")
             with self._tracer.span("executor.fast_reschedule") as span:
-                return self._try_fast_reschedule_traced(
+                result = self._try_fast_reschedule_traced(
                     executor, node_names, executor_resources, zone, span
                 )
+            if self._lane_health is not None:
+                if result is not None:
+                    self._lane_health.record_success(
+                        "tensor_reschedule", time.perf_counter() - t0
+                    )
+                else:
+                    # neutral: the lane declined (inexact snapshot) —
+                    # release a possible probe so it isn't wedged demoted
+                    self._lane_health.release_probe("tensor_reschedule")
+            return result
         except Exception:
+            if self._lane_health is not None:
+                self._lane_health.record_failure("tensor_reschedule")
             logger.exception("fast reschedule lane failed; using Quantity path")
             return None
 

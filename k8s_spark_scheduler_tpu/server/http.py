@@ -26,6 +26,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlsplit
 
+from ..resilience import AdmissionShed, deadline as req_deadline
 from ..tracing import spans as tracing
 from ..types import serde
 from .wiring import Server
@@ -146,7 +147,7 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/status/liveness":
             self._send_json(200, {"status": "up"})
         elif path == "/status/readiness":
-            ready = self.webhook_only or (
+            serving = self.webhook_only or (
                 self.scheduler is not None
                 and self.scheduler.informer_factory.wait_for_cache_sync()
                 # solver warmup still compiling: admitting traffic now
@@ -154,7 +155,18 @@ class _Handler(BaseHTTPRequestHandler):
                 # contention) on the first Filter requests
                 and self.scheduler.warmup_complete()
             )
-            self._send_json(200 if ready else 503, {"ready": ready})
+            kit = getattr(self.scheduler, "resilience", None)
+            if kit is None:
+                self._send_json(200 if serving else 503, {"ready": serving})
+                return
+            # tri-state: unready answers 503 (don't route here yet);
+            # degraded still answers 200 — a replica serving correct
+            # decisions with reduced machinery must NOT be pulled from
+            # rotation (that turns overload into an outage) — with the
+            # component breakdown in the body for operators
+            report = kit.health.report(serving=serving)
+            report["ready"] = serving
+            self._send_json(200 if serving else 503, report)
         elif path == "/metrics" and self.scheduler is not None:
             if self._wants_prometheus(query):
                 from ..metrics import prometheus as prom
@@ -261,12 +273,38 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as err:
                 self._send_json(400, {"error": f"bad ExtenderArgs: {err}"})
                 return
-            result = self.scheduler.extender.predicate(args)
+            result = self._predicate_guarded(args)
             self._send_json(200, serde.extender_filter_result_to_dict(result))
         elif self.path == "/convert":
             self._send_json(200, convert_review(body))
         else:
             self._send_json(404, {"error": "not found"})
+
+    def _predicate_guarded(self, args):
+        """Run the Filter under overload protection: a request deadline
+        derived from kube-scheduler's httpTimeout (checked at phase
+        boundaries inside the extender) and the bounded admission gate.
+        Shed requests answer immediately with a retriable all-nodes
+        failure — an extender protocol failure would abort the whole
+        scheduling cycle, a failed-nodes response just requeues the pod."""
+        from ..types.extenderapi import ExtenderFilterResult
+
+        kit = getattr(self.scheduler, "resilience", None)
+        if kit is None:
+            return self.scheduler.extender.predicate(args)
+        try:
+            with kit.gate.admit():
+                with req_deadline.bind(kit.request_timeout):
+                    return self.scheduler.extender.predicate(args)
+        except AdmissionShed:
+            span = tracing.current_span()
+            if span is not None:
+                span.tag("outcome", "shed")
+            return ExtenderFilterResult(
+                failed_nodes={
+                    n: "scheduler overloaded; retry" for n in args.node_names
+                }
+            )
 
 
 class ExtenderHTTPServer:

@@ -20,6 +20,7 @@ from ..metrics.reporters import ReporterSet
 from ..metrics.waste import WasteMetricsReporter
 from ..ops.nodesort import NodeSorter
 from ..ops.registry import select_binpacker
+from ..resilience import ResilienceKit, build_kit
 from ..scheduler.demand_gc import start_demand_gc
 from ..scheduler.extender import SparkSchedulerExtender
 from ..scheduler.overhead import OverheadComputer
@@ -64,6 +65,7 @@ class Server:
     tracer: Tracer = None
     reporters: "ReporterSet" = None
     waste_reporter: "WasteMetricsReporter" = None
+    resilience: ResilienceKit = None
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
@@ -294,6 +296,10 @@ class Server:
         self.unschedulable_marker.stop()
         self.resource_reservation_cache.stop()
         self.demand_cache.stop()
+        if self.resilience is not None:
+            # the journal keeps its pending (unlanded) intents on disk
+            # for the next instance's failover replay
+            self.resilience.journal.close()
         if warm_thread is not None:
             # a healthy compile finishes in seconds; a wedged device must
             # not stall shutdown past the grace period, so give up at the
@@ -345,10 +351,23 @@ def init_server_with_clients(
     # process, like the kube clientsets' QPS/Burst (cmd/clients.go:53-54)
     from ..kube.ratelimit import TokenBucket
 
+    # overload protection: admission gate, write-back breaker + intent
+    # journal, kernel-lane health, tri-state readiness (resilience/)
+    resilience_kit = build_kit(install.resilience, metrics=metrics)
+
     rate_bucket = TokenBucket(install.qps, install.burst) if install.qps > 0 else None
     rr_cache = ResourceReservationCache(
-        api, rr_informer, install.async_client.max_retry_count, rate_bucket=rate_bucket
+        api,
+        rr_informer,
+        install.async_client.max_retry_count,
+        rate_bucket=rate_bucket,
+        breaker=resilience_kit.breaker,
+        journal=resilience_kit.journal,
     )
+    # failover: intents journaled by a previous instance (durable
+    # journal-path) replay through the idempotent write path before any
+    # scheduling decision reads the cache
+    rr_cache.recover_from_journal()
     lazy_demand_informer = LazyDemandInformer(api, factory, poll_interval=demand_poll_interval)
     binpacker = select_binpacker(
         install.binpack_algo, strict_reference_parity=install.strict_reference_parity
@@ -405,6 +424,7 @@ def init_server_with_clients(
         tensor_snapshot_cache=tensor_snapshot,
         strict_reference_parity=install.strict_reference_parity,
         tracer=tracer,
+        resilience=resilience_kit,
     )
     marker = UnschedulablePodMarker(
         api,
@@ -438,6 +458,7 @@ def init_server_with_clients(
         event_log=event_log,
         tracer=tracer,
         waste_reporter=waste_reporter,
+        resilience=resilience_kit,
     )
     server.reporters = ReporterSet(server)
 

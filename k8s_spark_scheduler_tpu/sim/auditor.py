@@ -35,7 +35,7 @@ from typing import List
 from ..demands.manager import pod_name_from_demand
 from ..scheduler import invariants
 from ..scheduler.extender import FAILURE_EARLIER_DRIVER
-from ..types.objects import Demand, Pod
+from ..types.objects import Demand, Pod, ResourceReservation
 
 
 @dataclass
@@ -93,6 +93,7 @@ class Auditor:
         for v in invariants.check(self._server, raise_on_violation=False):
             self._violate(f"{v} [{label}]")
         self._check_demand_hygiene(label)
+        self._check_lost_intents(label)
         self._metrics.gauge("sim.audit.events", float(self.events_audited))
 
     def _check_demand_hygiene(self, label: str) -> None:
@@ -110,6 +111,36 @@ class Auditor:
                     f"D2[{label}]: demand {demand.name} still present after pod "
                     f"{pod_name} was scheduled to {pod.node_name}"
                 )
+
+    def _check_lost_intents(self, label: str) -> None:
+        """Zero-lost-reservation-intents (resilience/): after quiesce,
+        every reservation the scheduler admitted against must either be
+        at the API server or be covered by a pending intent-journal
+        entry (J1) — and symmetrically, a reservation the scheduler
+        deleted locally must be gone from the API server or have its
+        delete journaled (J2).  A key in neither place is an intent the
+        write-back layer silently lost."""
+        server = self._server
+        kit = getattr(server, "resilience", None)
+        pending = kit.journal.pending_keys() if kit is not None else set()
+        api_keys = {
+            (rr.namespace, rr.name)
+            for rr in server.api.list(ResourceReservation.KIND)
+        }
+        local_keys = {
+            (rr.namespace, rr.name)
+            for rr in server.resource_reservation_cache.list()
+        }
+        for key in sorted(local_keys - api_keys - pending):
+            self._violate(
+                f"J1[{label}]: reservation {key} admitted locally but neither "
+                f"written to the API server nor journaled (lost intent)"
+            )
+        for key in sorted(api_keys - local_keys - pending):
+            self._violate(
+                f"J2[{label}]: reservation {key} deleted locally but still at "
+                f"the API server with no journaled delete (lost intent)"
+            )
 
     def _violate(self, message: str) -> None:
         self.violations.append(message)

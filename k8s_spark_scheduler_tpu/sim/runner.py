@@ -106,6 +106,12 @@ class Simulation:
         finally:
             try:
                 if self.harness is not None:
+                    # disarm chaos hooks before teardown: they must never
+                    # leak into the next in-process simulation/test
+                    from ..ops import registry as ops_registry
+
+                    ops_registry.set_kernel_fault_hook(None)
+                    self.harness.api.set_write_fault(None)
                     self.harness.close()
             finally:
                 timesource.reset()
@@ -267,6 +273,12 @@ class Simulation:
             self._fault_executor_storm(fault)
         elif fault.kind == "failover":
             self._fault_failover()
+        elif fault.kind == "apiserver_outage":
+            self._fault_apiserver(fault, mode="outage")
+        elif fault.kind == "apiserver_latency":
+            self._fault_apiserver(fault, mode="latency")
+        elif fault.kind == "kernel_fault":
+            self._fault_kernel(fault)
         self._process(label, self._round(label))
 
     def _fault_node_kill(self, fault: FaultSpec) -> None:
@@ -338,6 +350,87 @@ class Simulation:
             soft.remove_driver_reservation(app_id)
         with extender._predicate_lock:
             sync_resource_reservations_and_demands(extender)
+
+    # faulted kinds: the scheduler's OWN write-back traffic (CRDs).  The
+    # runner's Node/Pod mutations and server-side owner GC stay up — the
+    # fault models the scheduler's client losing the API server, not the
+    # cluster's control plane disappearing wholesale
+    _FAULTED_KINDS = frozenset({"ResourceReservation", "Demand"})
+
+    def _fault_apiserver(self, fault: FaultSpec, mode: str) -> None:
+        """Start an API-server write-fault window; the clearing event is
+        a scheduled clock event so recovery is deterministic."""
+        from ..kube.errors import APIError
+
+        kinds = self._FAULTED_KINDS
+        if mode == "outage":
+
+            def inject(op, kind, ns, name):
+                if kind in kinds:
+                    return APIError(f"injected apiserver outage ({op} {kind} {ns}/{name})")
+                return None
+
+        else:
+            # latency spike as the client observes it: every key's FIRST
+            # write attempt times out, the retry lands.  Per-key (not a
+            # global counter) so the failing set is independent of worker
+            # thread interleaving — the digest stays reproducible.
+            seen: set = set()
+
+            def inject(op, kind, ns, name):
+                if kind in kinds and (op, kind, ns, name) not in seen:
+                    seen.add((op, kind, ns, name))
+                    return APIError(
+                        f"injected apiserver latency: client timeout ({op} {kind})"
+                    )
+                return None
+
+        self.harness.api.set_write_fault(inject)
+        self.clock.schedule(
+            self.clock.now() + fault.duration,
+            f"fault-clear:apiserver_{mode}",
+            lambda m=mode: self._on_apiserver_fault_clear(m),
+        )
+
+    def _on_apiserver_fault_clear(self, mode: str) -> None:
+        self.harness.api.set_write_fault(None)
+        self._recover_writeback()
+        label = f"fault-clear:apiserver_{mode}"
+        self._process(label, self._round(label))
+
+    def _recover_writeback(self) -> None:
+        """Deterministic recovery: force the breaker's probe window open
+        and replay the intent journal until it drains (the first probe's
+        success closes the breaker, which re-enqueues the rest)."""
+        cache = self.harness.server.resource_reservation_cache
+        h = self.harness
+        for _ in range(6):
+            if cache.journal_depth() == 0:
+                break
+            cache.nudge_recovery(force=True)
+            h.wait_for_api(
+                lambda: not any(cache.inflight_queue_lengths()), timeout=10.0
+            )
+
+    def _fault_kernel(self, fault: FaultSpec) -> None:
+        """Arm the kernel chaos hook for the window: every device-lane
+        dispatch raises through the extender's real fallback path, so
+        lane demotion (and the post-cooloff re-probe) is exercised."""
+        from ..ops import registry as ops_registry
+
+        until = self.clock.now() + fault.duration
+
+        def inject(lane):
+            if self.clock.now() < until:
+                return RuntimeError(f"injected kernel fault ({lane})")
+            return None
+
+        ops_registry.set_kernel_fault_hook(inject)
+        self.clock.schedule(
+            until,
+            "fault-clear:kernel_fault",
+            lambda: ops_registry.set_kernel_fault_hook(None),
+        )
 
     def _kill_app(self, app_id: str) -> None:
         app = self._apps.get(app_id)

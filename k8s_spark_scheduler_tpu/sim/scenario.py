@@ -40,7 +40,20 @@ Fault catalog (all deterministic under the scenario seed):
   soft-reservation tombstone race;
 - ``failover``: wipe the (intentionally unpersisted) soft-reservation
   store and run ``scheduler/failover.py`` reconciliation, as a fresh
-  leader would.
+  leader would;
+- ``apiserver_outage``: for ``duration`` virtual seconds every CRD
+  write from the scheduler's async client fails — the write-back
+  breaker opens and reservation intents divert to the journal; at the
+  window's end the runner injects the recovery signal and the journal
+  replays (resilience/);
+- ``apiserver_latency``: for ``duration`` virtual seconds every CRD
+  write's FIRST attempt per key fails with a retriable timeout (the
+  client-observed shape of a latency spike); retries land, so the
+  breaker sees interleaved failures without a hard outage;
+- ``kernel_fault``: for ``duration`` virtual seconds every device
+  kernel lane dispatch raises, driving lane demotion to the host path
+  and, after the window + cooloff, re-probe and promotion
+  (resilience/lanehealth.py).
 """
 
 from __future__ import annotations
@@ -49,7 +62,16 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-FAULT_KINDS = {"node_kill", "node_cordon", "node_uncordon", "executor_storm", "failover"}
+FAULT_KINDS = {
+    "node_kill",
+    "node_cordon",
+    "node_uncordon",
+    "executor_storm",
+    "failover",
+    "apiserver_outage",
+    "apiserver_latency",
+    "kernel_fault",
+}
 
 
 @dataclass
@@ -79,6 +101,9 @@ class FaultSpec:
     count: int = 1
     apps: int = 1
     fraction: float = 0.5
+    # window length (virtual seconds) for the windowed faults:
+    # apiserver_outage / apiserver_latency / kernel_fault
+    duration: float = 60.0
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:

@@ -182,6 +182,114 @@ def test_reconcile_triggered_after_idle(harness, monkeypatch):
     assert harness.get_resource_reservation("app-idle") is not None
 
 
+def test_journal_replay_exactly_once_across_failover(tmp_path):
+    """Reservation intents diverted to the durable journal during an
+    API-server outage replay exactly once across a leader failover: the
+    new instance lands each unlanded intent with ONE CRD write, a third
+    instance (journal already drained/acked) writes nothing, and the
+    invariants stay clean (resilience/journal.py + typed_caches.py
+    recover_from_journal)."""
+    from k8s_spark_scheduler_tpu.config import Install, ResilienceConfig
+    from k8s_spark_scheduler_tpu.kube.errors import APIError
+    from k8s_spark_scheduler_tpu.server.wiring import init_server_with_clients
+
+    journal_path = str(tmp_path / "intents.jsonl")
+
+    def install():
+        return Install(
+            fifo=True,
+            binpack_algo="tightly-pack",
+            resilience=ResilienceConfig(
+                journal_path=journal_path, breaker_failure_threshold=1
+            ),
+        )
+
+    h = Harness(extra_install=install())
+    rr_writes = {"create": 0, "update": 0}
+    real_create, real_update = h.api.create, h.api.update
+
+    def counting_create(obj):
+        result = real_create(obj)  # raises under the injected fault
+        if obj.KIND == "ResourceReservation":
+            rr_writes["create"] += 1
+        return result
+
+    def counting_update(obj):
+        result = real_update(obj)
+        if obj.KIND == "ResourceReservation":
+            rr_writes["update"] += 1
+        return result
+
+    h.api.create, h.api.update = counting_create, counting_update
+    second = third = None
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        nodes = ["n1", "n2"]
+        # outage: every CRD write from the scheduler's client fails
+        h.api.set_write_fault(
+            lambda op, kind, ns, name: APIError("injected outage")
+            if kind in ("ResourceReservation", "Demand")
+            else None
+        )
+        pods = h.static_allocation_spark_pods("app-fo", 1)
+        for p in pods:
+            h.assert_success(h.schedule(p, nodes))
+        kit = h.server.resilience
+        assert h.wait_for_api(
+            lambda: kit.journal.pending_keys() == {("default", "app-fo")}
+        )
+        assert h.api.list("ResourceReservation") == []
+
+        # the leader dies mid-outage; the journal file survives it
+        h.server.stop()
+        h.api.set_write_fault(None)
+        assert rr_writes == {"create": 0, "update": 0}
+
+        # new leader: wiring replays the journal through the idempotent
+        # write path before serving
+        second = init_server_with_clients(h.api, install(), demand_poll_interval=0.02)
+        assert h.wait_for_api(
+            lambda: len(h.api.list("ResourceReservation")) == 1
+        )
+        assert h.wait_for_api(
+            lambda: second.resilience.journal.depth() == 0
+        )
+        rr = h.api.list("ResourceReservation")[0]
+        # the landed object is the journaled (post-executor-bind) state
+        assert pods[1].name in rr.status.pods.values()
+        assert rr_writes["create"] == 1
+
+        from k8s_spark_scheduler_tpu.scheduler import invariants
+
+        assert invariants.check(second, raise_on_violation=False) == []
+        second.stop()
+        writes_after_second = dict(rr_writes)
+
+        # a third instance sees an empty journal: zero duplicate writes
+        third = init_server_with_clients(h.api, install(), demand_poll_interval=0.02)
+        assert third.resilience.journal.depth() == 0
+        assert h.wait_for_api(
+            lambda: third.resource_reservation_cache.get("default", "app-fo")
+            is not None
+        )
+        time.sleep(0.2)  # let any (wrong) replay write-back surface
+        assert rr_writes == writes_after_second
+        assert len(h.api.list("ResourceReservation")) == 1
+    finally:
+        h.api.create, h.api.update = real_create, real_update
+        for server in (second, third):
+            if server is not None:
+                try:
+                    server.stop()
+                except Exception:
+                    pass
+        try:
+            h.close()
+        except Exception:
+            pass
+
+
 def test_leader_failover_new_instance_rebuilds_state():
     """The checkpoint/resume contract (SURVEY §5): durable state is the
     reservation/demand objects at the API server; a NEW scheduler

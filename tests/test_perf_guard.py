@@ -173,6 +173,58 @@ def test_sim_throughput_budget():
     assert result.summary["sim_speedup"] >= 20.0
 
 
+# -- resilience overhead guard ------------------------------------------------
+#
+# The overload-protection layer must be ~free on the happy path: a bound
+# deadline costs one contextvar read + monotonic call per phase boundary,
+# the admission gate one small critical section per request.  Budget is
+# 5% relative over the bare predicate (ISSUE 3 acceptance) plus a small
+# absolute slack so a sub-millisecond baseline isn't flaky under CI load.
+
+
+def test_deadline_and_gate_overhead_within_budget():
+    from k8s_spark_scheduler_tpu.resilience import deadline as req_deadline
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h = Harness()
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        driver = h.static_allocation_spark_pods("app-res-perf", 1)[0]
+        h.assert_success(h.schedule(driver, ["n1", "n2"]))  # creates the RR
+
+        extender = h.server.extender
+        kit = h.server.resilience
+        args = ExtenderArgs(pod=driver, node_names=["n1", "n2"])
+        n = 50
+
+        # idempotent driver replay: stable, reservation-backed request
+        def bare_batch():
+            for _ in range(n):
+                extender.predicate(args)
+
+        def guarded_batch():
+            # exactly what the HTTP layer adds per request
+            for _ in range(n):
+                with kit.gate.admit():
+                    with req_deadline.bind(kit.request_timeout):
+                        extender.predicate(args)
+
+        bare_batch()
+        guarded_batch()  # warm both
+        bare_s = _best_of(bare_batch)
+        guarded_s = _best_of(guarded_batch)
+
+        budget = bare_s * 1.05 + n * 0.2e-3  # 5% relative + 0.2ms/request
+        assert guarded_s <= budget, (
+            f"resilience overhead: {guarded_s * 1e3:.2f}ms per {n}-request batch "
+            f"guarded vs {bare_s * 1e3:.2f}ms bare (budget {budget * 1e3:.2f}ms)"
+        )
+    finally:
+        h.close()
+
+
 def test_predicate_latency_with_tracing_within_budget():
     from k8s_spark_scheduler_tpu.testing.harness import Harness
 

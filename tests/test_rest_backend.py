@@ -317,3 +317,55 @@ def test_full_scheduler_wiring_over_rest():
         server.stop()
         backend.stop()
         fake.stop()
+
+
+# -- watch-reconnect backoff jitter -------------------------------------------
+#
+# Both watch error paths (stream drop AND relist-after-410 failure) must
+# draw from the same full-jitter distribution with the same cap: a
+# jitterless path re-synchronizes a fleet of watchers onto a recovering
+# API server exactly when it matters most.
+
+
+def test_watch_backoff_full_jitter_bounds():
+    import random
+
+    from k8s_spark_scheduler_tpu.kube.restbackend import (
+        WATCH_BACKOFF_CAP_S,
+        WATCH_BACKOFF_INITIAL_S,
+        next_watch_backoff,
+        watch_backoff_delay,
+    )
+
+    rng = random.Random(20260804)
+    backoff = WATCH_BACKOFF_INITIAL_S
+    windows = []
+    for _ in range(12):
+        for _ in range(50):
+            delay = watch_backoff_delay(backoff, rng=rng)
+            # full jitter: uniform over [0, min(backoff, cap)]
+            assert 0.0 <= delay <= min(backoff, WATCH_BACKOFF_CAP_S)
+        windows.append(backoff)
+        backoff = next_watch_backoff(backoff)
+    # exponential growth, capped at 30s and pinned there
+    assert windows[0] == WATCH_BACKOFF_INITIAL_S
+    assert windows[1] == WATCH_BACKOFF_INITIAL_S * 2
+    assert max(windows) == WATCH_BACKOFF_CAP_S == 30.0
+    assert backoff == WATCH_BACKOFF_CAP_S
+    # the draw actually spreads over the window (not pinned to an edge)
+    draws = [watch_backoff_delay(30.0, rng=rng) for _ in range(200)]
+    assert min(draws) < 5.0 and max(draws) > 25.0
+
+
+def test_watch_error_paths_share_the_jittered_backoff():
+    """Pin that BOTH reconnect paths route through watch_backoff_delay
+    (the relist path used to sleep jitterless)."""
+    import inspect
+
+    from k8s_spark_scheduler_tpu.kube import restbackend
+
+    src = inspect.getsource(restbackend._KindWatch._run)
+    assert src.count("watch_backoff_delay(backoff)") == 2
+    assert src.count("next_watch_backoff(backoff)") == 2
+    # no raw un-jittered wait on the backoff value remains
+    assert "wait(backoff)" not in src.replace("watch_backoff_delay(backoff)", "")
