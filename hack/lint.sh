@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Local mirror of the CI lint job: schedlint (always — it ships with the
+# package) plus ruff and mypy when installed (pip install -e ".[lint]").
+# Exit nonzero on any finding so it can gate a pre-push hook.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+rc=0
+
+echo "==> schedlint (python -m k8s_spark_scheduler_tpu.analysis --strict)"
+python -m k8s_spark_scheduler_tpu.analysis --strict || rc=1
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "==> ruff check"
+    ruff check k8s_spark_scheduler_tpu || rc=1
+else
+    echo "==> ruff not installed — skipping (pip install -e '.[lint]')"
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+    echo "==> mypy"
+    mypy || rc=1
+else
+    echo "==> mypy not installed — skipping (pip install -e '.[lint]')"
+fi
+
+exit $rc

@@ -1,0 +1,111 @@
+"""CLI: ``python -m k8s_spark_scheduler_tpu.analysis [--strict] [paths]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .core import (
+    AnalysisConfig,
+    analyze_paths,
+    load_allowlist,
+    package_root,
+)
+from .reporters import render_json, render_text
+
+_RULE_CATALOGUE = """\
+schedlint rules (see docs/development.md for worked examples):
+
+determinism
+  TS001  direct time.time() — semantic timestamps must use timesource.now()
+  TS002  direct time.monotonic() — infra-only (allowlist or justified pragma)
+  TS003  datetime.now()/utcnow()/today() bypasses the timesource
+  DT001  unseeded randomness (global random.* or random.Random())
+  DT002  legacy NumPy global RNG (numpy.random.*)
+
+locking
+  LK001  mutation of a @guarded_by attribute outside 'with self.<lock>:'
+  LK002  bare .acquire() without try/finally release
+  LK003  @guarded_by declaration whose lock attr is never assigned in __init__
+
+tracer-safety (JAX kernels)
+  JX001  Python if/while on a traced value inside a jitted function
+  JX002  bool()/int()/float()/.item() concretizes a traced value under jit
+  JX003  jitted function closes over mutable module state or self attributes
+  JX004  unhashable static argument (mutable default or literal at call site)
+
+pragma
+  PR000  file does not parse
+  PR001  (--strict) pragma without a '-- justification'
+"""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m k8s_spark_scheduler_tpu.analysis",
+        description="schedlint: determinism, lock-discipline and JAX "
+        "tracer-safety analysis for the gang scheduler",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to analyze (default: the installed package)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="require a justification on every pragma (PR001)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule-id prefixes to run (e.g. TS,DT or LK001)",
+    )
+    parser.add_argument(
+        "--allowlist", default=None, metavar="FILE",
+        help="JSON allowlist merged over the built-in one",
+    )
+    parser.add_argument(
+        "--no-default-allowlist", action="store_true",
+        help="ignore the built-in allowlist (audit mode)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_RULE_CATALOGUE, end="")
+        return 0
+
+    extra_allowlist = {}
+    if args.allowlist:
+        try:
+            extra_allowlist = load_allowlist(args.allowlist)
+        except (OSError, ValueError) as exc:
+            print(f"schedlint: bad allowlist: {exc}", file=sys.stderr)
+            return 2
+
+    config = AnalysisConfig(
+        select=tuple(s.strip() for s in args.select.split(",")) if args.select else None,
+        allowlist=extra_allowlist,
+        use_default_allowlist=not args.no_default_allowlist,
+        strict=args.strict,
+    )
+    root = package_root()
+    paths = args.paths or [root]
+    findings = analyze_paths(paths, config=config, root=root)
+
+    if args.fmt == "json":
+        sys.stdout.write(render_json(findings, strict=args.strict))
+    else:
+        sys.stdout.write(render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

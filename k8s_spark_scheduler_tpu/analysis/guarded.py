@@ -1,0 +1,71 @@
+"""``@guarded_by`` — declared lock discipline for mutable shared state.
+
+A class decorator that records, per class, which attributes are guarded
+by which lock attribute::
+
+    @guarded_by("_lock", "_store", "_observers")
+    class ObjectStore: ...
+
+The declaration is consumed twice:
+
+- **statically** by the LK rule family (``analysis/rules_locks.py``):
+  any mutation of a declared attribute outside a lexical
+  ``with self._lock:`` scope (``__init__`` excepted — construction
+  happens-before publication) is a finding;
+- **at runtime** by the lockset race detector
+  (:mod:`.racecheck`): when the detector is active, instances created
+  by a decorated class get their lock attribute wrapped in a tracked
+  proxy so the detector knows exactly which locks each thread holds at
+  every instrumented mutation.
+
+The decorator is a no-op in production: with the detector inactive it
+only registers metadata and returns the class unchanged apart from a
+thin ``__init__`` wrapper (one attribute check per construction).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple, Type
+
+# class → (lock attribute name, tuple of guarded attribute names).
+# Keyed by the class object itself so subclasses don't alias.
+_REGISTRY: Dict[Type, Tuple[str, Tuple[str, ...]]] = {}
+
+
+def guarded_by(lock_attr: str, *fields: str):
+    """Declare that ``fields`` of the decorated class are only mutated
+    while ``self.<lock_attr>`` is held.  ``lock_attr`` must be assigned
+    in ``__init__``."""
+
+    def decorate(cls):
+        _REGISTRY[cls] = (lock_attr, tuple(fields))
+        original_init = cls.__init__
+
+        @functools.wraps(original_init)
+        def init(self, *args, **kwargs):
+            original_init(self, *args, **kwargs)
+            # late import: racecheck imports nothing heavy, but keeping
+            # the hot (disabled) path to one module-attribute read
+            from . import racecheck
+
+            if racecheck.active():
+                racecheck.instrument_instance(self, cls, lock_attr)
+
+        cls.__init__ = init
+        return cls
+
+    return decorate
+
+
+def guarded_fields(cls: Type) -> Tuple[str, Tuple[str, ...]]:
+    """(lock_attr, fields) declared for ``cls`` (or the nearest
+    decorated base), or ``("", ())`` when undeclared."""
+    for klass in cls.__mro__:
+        if klass in _REGISTRY:
+            return _REGISTRY[klass]
+    return "", ()
+
+
+def registry() -> Dict[Type, Tuple[str, Tuple[str, ...]]]:
+    return dict(_REGISTRY)

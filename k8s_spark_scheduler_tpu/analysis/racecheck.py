@@ -1,0 +1,356 @@
+"""Eraser-style runtime lockset race detector + lock-order recorder.
+
+Static lock-discipline rules (LK*) catch mutations that are *lexically*
+outside the declared ``with lock:`` scope; this module catches what the
+AST cannot: a mutation reached on a path where the lock genuinely is
+not held, and lock acquisition orders that could deadlock.
+
+The classic lockset algorithm (Savage et al., "Eraser", SOSP '97),
+adapted to instrumented checkpoints instead of binary instrumentation:
+
+- every :func:`guarded_by <.guarded.guarded_by>`-decorated class built
+  while the detector is active gets its lock attribute wrapped in a
+  :class:`TrackedLock` proxy that maintains a per-thread held-lock set
+  and feeds the lock-order graph;
+- mutation sites in the shared-state hot paths call
+  :func:`note_access`, which intersects the candidate lockset for
+  ``(instance, field)`` with the locks currently held;
+- a field that has been written by two or more threads with an empty
+  candidate lockset is reported as a race (state machine:
+  virgin → exclusive(first thread) → shared → shared-modified, exactly
+  Eraser's refinement so single-threaded init and read-sharing don't
+  false-positive);
+- acquiring lock B while holding lock A adds edge A→B to a global
+  acquisition graph; a path B⇝A already present means a lock-order
+  cycle (potential deadlock) and is recorded with both stacks' lock
+  names.
+
+Enablement: ``SCHEDLINT_RACECHECK=1`` in the environment makes the test
+harness and the sim runner call :func:`enable` before any guarded
+instance is constructed; tests may also call :func:`enable` /
+:func:`disable` directly.  When inactive, :func:`note_access` is a
+single module-attribute read and a ``None`` check — cheap enough to
+leave in the hot paths permanently.
+
+Instances constructed *before* the detector was enabled carry untracked
+raw locks; their accesses are skipped (``_schedlint_tracked`` marker)
+rather than misreported as lock-free.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+ENV_FLAG = "SCHEDLINT_RACECHECK"
+
+# Eraser field states
+_VIRGIN = 0
+_EXCLUSIVE = 1
+_SHARED = 2
+_SHARED_MODIFIED = 3
+
+
+def enabled_via_env() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false")
+
+
+@dataclass
+class RaceReport:
+    owner: str           # ClassName#n
+    field: str
+    threads: Tuple[str, ...]
+    note: str
+
+    def __str__(self) -> str:
+        return (
+            f"unprotected shared write: {self.owner}.{self.field} "
+            f"written by {', '.join(self.threads)} with empty lockset ({self.note})"
+        )
+
+
+@dataclass
+class LockOrderReport:
+    edge: Tuple[str, str]      # the acquisition that closed the cycle
+    cycle: Tuple[str, ...]     # lock names along the pre-existing path
+
+    def __str__(self) -> str:
+        a, b = self.edge
+        return (
+            f"lock-order cycle: acquiring {b} while holding {a}, but "
+            f"{' -> '.join(self.cycle)} already recorded"
+        )
+
+
+class TrackedLock:
+    """Proxy over a real ``Lock``/``RLock`` that maintains the calling
+    thread's held-lock set and the global acquisition-order graph.
+    Reentrant acquisitions (RLock) are counted so the held set stays
+    accurate."""
+
+    def __init__(self, inner, name: str, detector: "RaceDetector"):
+        self._inner = inner
+        self.name = name
+        self._detector = detector
+        self._counts = threading.local()
+
+    # -- lock protocol --------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._on_acquired()
+        return got
+
+    def release(self) -> None:
+        self._on_release()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()  # schedlint: disable=LK002 -- lock proxy: __exit__ is the paired release
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        if inner_locked is not None:
+            return inner_locked()
+        # RLock grows .locked() only in Python 3.14; approximate: held by
+        # this thread, else a non-blocking probe (net-zero, untracked)
+        if self._depth() > 0:
+            return True
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    # -- tracking -------------------------------------------------------------
+
+    def _depth(self) -> int:
+        return getattr(self._counts, "n", 0)
+
+    def _on_acquired(self) -> None:
+        n = self._depth()
+        self._counts.n = n + 1
+        if n == 0:  # outermost acquisition only
+            self._detector._lock_acquired(self)
+
+    def _on_release(self) -> None:
+        n = self._depth()
+        if n <= 1:
+            self._counts.n = 0
+            self._detector._lock_released(self)
+        else:
+            self._counts.n = n - 1
+
+
+@dataclass
+class _FieldState:
+    state: int = _VIRGIN
+    first_thread: Optional[int] = None
+    lockset: Optional[FrozenSet[str]] = None   # None = universe (virgin)
+    threads: Set[str] = field(default_factory=set)
+    reported: bool = False
+
+
+class RaceDetector:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._held = threading.local()          # per-thread list of TrackedLock
+        self._thread_seq = 0
+        self._instances: Dict[int, str] = {}    # id(owner) → display name
+        self._by_class_seq: Dict[str, int] = {}
+        self._fields: Dict[Tuple[int, str], _FieldState] = {}
+        self._edges: Dict[str, Set[str]] = {}   # lock name → successors
+        self.races: List[RaceReport] = []
+        self.lock_order_violations: List[LockOrderReport] = []
+
+    # -- lock bookkeeping -----------------------------------------------------
+
+    def _held_stack(self) -> List[TrackedLock]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def held_lock_names(self) -> FrozenSet[str]:
+        return frozenset(lk.name for lk in self._held_stack())
+
+    def _thread_token(self) -> int:
+        """Unique, never-recycled id for the calling thread.  (OS thread
+        idents from ``threading.get_ident()`` ARE recycled once a thread
+        exits — a fast first writer's ident can be reused by the second,
+        making a two-thread race look single-threaded.)"""
+        token = getattr(self._held, "token", None)
+        if token is None:
+            with self._mu:
+                self._thread_seq += 1
+                token = self._thread_seq
+            self._held.token = token
+        return token
+
+    def _lock_acquired(self, lock: TrackedLock) -> None:
+        stack = self._held_stack()
+        if stack:
+            self._record_edge(stack[-1].name, lock.name)
+        stack.append(lock)
+
+    def _lock_released(self, lock: TrackedLock) -> None:
+        stack = self._held_stack()
+        # locks are almost always released LIFO; tolerate out-of-order
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    def _record_edge(self, held: str, acquiring: str) -> None:
+        if held == acquiring:
+            return
+        with self._mu:
+            succs = self._edges.setdefault(held, set())
+            if acquiring in succs:
+                return
+            # does a path acquiring ⇝ held already exist?  Then this
+            # acquisition closes a cycle.
+            path = self._find_path(acquiring, held)
+            succs.add(acquiring)
+            if path is not None:
+                self.lock_order_violations.append(
+                    LockOrderReport(edge=(held, acquiring), cycle=tuple(path))
+                )
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        # iterative DFS over the (small) acquisition graph; caller holds _mu
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- instance registration ------------------------------------------------
+
+    def register_instance(self, owner: object, cls: type, lock_attr: str) -> None:
+        """Wrap ``owner.<lock_attr>`` in a TrackedLock (once) and mark
+        the instance as instrumented."""
+        inner = getattr(owner, lock_attr, None)
+        if inner is None or isinstance(inner, TrackedLock):
+            return
+        with self._mu:
+            seq = self._by_class_seq.get(cls.__name__, 0)
+            self._by_class_seq[cls.__name__] = seq + 1
+        name = f"{cls.__name__}.{lock_attr}#{seq}"
+        object.__setattr__(owner, lock_attr, TrackedLock(inner, name, self))
+        self._instances[id(owner)] = f"{cls.__name__}#{seq}"
+        object.__setattr__(owner, "_schedlint_tracked", True)
+
+    # -- the lockset algorithm ------------------------------------------------
+
+    def record_access(self, owner: object, fieldname: str, write: bool) -> None:
+        if not getattr(owner, "_schedlint_tracked", False):
+            return
+        if id(owner) not in self._instances:
+            # instrumented by a DIFFERENT detector instance: its lock
+            # reports to that detector's held stacks, so judging it
+            # against this one's (empty) stacks would fabricate races
+            return
+        held = self.held_lock_names()
+        tid = self._thread_token()
+        tname = threading.current_thread().name
+        key = (id(owner), fieldname)
+        with self._mu:
+            st = self._fields.setdefault(key, _FieldState())
+            st.threads.add(tname)
+            if st.state == _VIRGIN:
+                st.state = _EXCLUSIVE
+                st.first_thread = tid
+                st.lockset = held
+                return
+            st.lockset = (st.lockset & held) if st.lockset is not None else held
+            if st.state == _EXCLUSIVE:
+                if tid == st.first_thread:
+                    return
+                st.state = _SHARED_MODIFIED if write else _SHARED
+            elif st.state == _SHARED and write:
+                st.state = _SHARED_MODIFIED
+            if st.state == _SHARED_MODIFIED and not st.lockset and not st.reported:
+                st.reported = True
+                self.races.append(
+                    RaceReport(
+                        owner=self._instances.get(id(owner), type(owner).__name__),
+                        field=fieldname,
+                        threads=tuple(sorted(st.threads)),
+                        note="candidate lockset became empty",
+                    )
+                )
+
+    # -- reporting ------------------------------------------------------------
+
+    def clean(self) -> bool:
+        return not self.races and not self.lock_order_violations
+
+    def report_lines(self) -> List[str]:
+        return [str(r) for r in self.races] + [
+            str(v) for v in self.lock_order_violations
+        ]
+
+
+# -- module-level switchboard -------------------------------------------------
+
+_active: Optional[RaceDetector] = None
+
+
+def active() -> bool:
+    return _active is not None
+
+
+def get() -> Optional[RaceDetector]:
+    return _active
+
+
+def enable(detector: Optional[RaceDetector] = None) -> RaceDetector:
+    """Install ``detector`` (or a fresh one) as the process-wide race
+    detector.  Idempotent: enabling while active keeps the existing
+    detector unless a new one is passed explicitly."""
+    global _active
+    if detector is not None:
+        _active = detector
+    elif _active is None:
+        _active = RaceDetector()
+    return _active
+
+
+def disable() -> Optional[RaceDetector]:
+    """Deactivate and return the detector (for post-run assertions)."""
+    global _active
+    d, _active = _active, None
+    return d
+
+
+def enable_if_env() -> Optional[RaceDetector]:
+    """Harness/sim hook: enable when ``SCHEDLINT_RACECHECK`` is set."""
+    return enable() if enabled_via_env() else None
+
+
+def instrument_instance(owner: object, cls: type, lock_attr: str) -> None:
+    d = _active
+    if d is not None:
+        d.register_instance(owner, cls, lock_attr)
+
+
+def note_access(owner: object, fieldname: str, write: bool = True) -> None:
+    """Instrumentation checkpoint placed inside shared-state mutators.
+    Near-zero cost while the detector is inactive."""
+    d = _active
+    if d is not None:
+        d.record_access(owner, fieldname, write)

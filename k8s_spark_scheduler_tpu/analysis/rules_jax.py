@@ -1,0 +1,385 @@
+"""JX — tracer-safety rules for the JAX kernels in ``ops/``.
+
+The binpack hot path is a set of ``@jax.jit`` kernels whose contracts
+only surface as perf-guard regressions after the fact: a Python branch
+on a traced value raises ``TracerBoolConversionError`` at runtime (or,
+worse, silently retraces per call when the branched value happens to be
+weakly-typed), a non-hashable static argument raises at dispatch, and a
+closure over mutable module state bakes a stale snapshot into the
+compiled executable.  These rules catch the known hazards at lint time.
+
+A function is *jitted* when it is decorated with ``jax.jit`` /
+``functools.partial(jax.jit, ...)`` or wrapped by a module-level
+``name = jax.jit(fn)`` assignment.  Parameters named in
+``static_argnames`` / positioned in ``static_argnums`` are *static*
+(concrete at trace time) — branching on them is the supported idiom and
+is never flagged.  Attribute reads that stay static under tracing
+(``x.shape``, ``x.ndim``, ``x.dtype``, ``x.size``) are excluded.
+
+Rules:
+
+- **JX001** — ``if``/``while`` whose test reads a traced (non-static)
+  parameter: concretizes the tracer; use ``jnp.where`` / ``lax.cond`` /
+  ``lax.while_loop``.
+- **JX002** — explicit concretization of a traced parameter:
+  ``bool(x)``, ``int(x)``, ``float(x)``, or ``x.item()``.
+- **JX003** — a jitted function reads module-level *mutable* state (a
+  list/dict/set binding) or ``self`` attributes: the value is captured
+  at trace time and silently goes stale — pass it as an argument.
+- **JX004** — a static argument that cannot be hashed: a
+  ``static_argnames`` parameter with a mutable default, or a same-module
+  call site passing a list/dict/set literal for a static parameter.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileContext, Finding
+
+_STATIC_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_CONCRETIZERS = {"bool", "int", "float"}
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "deque", "defaultdict", "OrderedDict"}
+
+
+def _finding(ctx: FileContext, rule: str, node: ast.AST, message: str, symbol: str) -> Finding:
+    return Finding(
+        rule=rule,
+        category="tracer-safety",
+        file=ctx.relpath,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+        symbol=symbol,
+    )
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` or bare ``jit`` (from-imported)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_decoration(deco: ast.AST) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when ``deco`` is a jit
+    decorator, else None."""
+    if _is_jax_jit(deco):
+        return set(), set()
+    if isinstance(deco, ast.Call):
+        # functools.partial(jax.jit, static_argnames=(...)) or jax.jit(...)
+        target = None
+        fn = deco.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "partial" or (
+            isinstance(fn, ast.Name) and fn.id == "partial"
+        ):
+            if deco.args and _is_jax_jit(deco.args[0]):
+                target = deco
+        elif _is_jax_jit(fn):
+            target = deco
+        if target is None:
+            return None
+        names: Set[str] = set()
+        nums: Set[int] = set()
+        for kw in target.keywords:
+            if kw.arg == "static_argnames":
+                names |= _string_elements(kw.value)
+            elif kw.arg == "static_argnums":
+                nums |= _int_elements(kw.value)
+        return names, nums
+    return None
+
+
+def _string_elements(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    return out
+
+
+def _int_elements(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+    return out
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        return name in _MUTABLE_CTORS
+    return False
+
+
+class _ModuleIndex:
+    """Module-level bindings + which function defs are jitted and how."""
+
+    def __init__(self, tree: ast.Module):
+        self.mutable_globals: Set[str] = set()
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        # fn name → (static names, static nums); may be registered via a
+        # decorator or a module-level `x = jax.jit(fn, ...)` wrapper
+        self.jitted: Dict[str, Tuple[Set[str], Set[int]]] = {}
+        # wrapper alias → wrapped fn name (solve_zones_jit = jax.jit(solve_zones))
+        self.jit_aliases: Dict[str, str] = {}
+
+        for stmt in tree.body:
+            if isinstance(stmt, ast.FunctionDef):
+                self.functions[stmt.name] = stmt
+                for deco in stmt.decorator_list:
+                    statics = _jit_decoration(deco)
+                    if statics is not None:
+                        self.jitted[stmt.name] = statics
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                value = stmt.value
+                if value is None:
+                    continue
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if _is_mutable_literal(value):
+                        self.mutable_globals.add(t.id)
+                    if (
+                        isinstance(value, ast.Call)
+                        and _is_jax_jit(value.func)
+                        and value.args
+                        and isinstance(value.args[0], ast.Name)
+                    ):
+                        wrapped = value.args[0].id
+                        self.jit_aliases[t.id] = wrapped
+                        names: Set[str] = set()
+                        nums: Set[int] = set()
+                        for kw in value.keywords:
+                            if kw.arg == "static_argnames":
+                                names |= _string_elements(kw.value)
+                            elif kw.arg == "static_argnums":
+                                nums |= _int_elements(kw.value)
+                        self.jitted.setdefault(wrapped, (names, nums))
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+
+
+def _static_params(fn: ast.FunctionDef, statics: Tuple[Set[str], Set[int]]) -> Set[str]:
+    names, nums = statics
+    params = _param_names(fn)
+    out = set(names)
+    for i in nums:
+        if 0 <= i < len(params):
+            out.add(params[i])
+    return out
+
+
+class _JitBodyChecker(ast.NodeVisitor):
+    """Checks one jitted function body for JX001/JX002/JX003."""
+
+    def __init__(self, ctx: FileContext, fn: ast.FunctionDef, statics: Tuple[Set[str], Set[int]], index: _ModuleIndex):
+        self.ctx = ctx
+        self.fn = fn
+        self.index = index
+        self.static = _static_params(fn, statics)
+        self.traced = set(_param_names(fn)) - self.static
+        self.findings: List[Finding] = []
+        self._locals: Set[str] = set(_param_names(fn))
+        # pre-collect every name assigned anywhere in the body: reads of
+        # those are locals (possibly defined later in a loop), not
+        # closure captures
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                self._locals.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fn:
+                    self._locals.add(node.name)
+
+    def symbol(self) -> str:
+        return self.fn.name
+
+    # -- JX001: control flow on traced values ---------------------------------
+
+    def _traced_names_in_test(self, test: ast.AST) -> List[ast.Name]:
+        hits: List[ast.Name] = []
+        parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(test):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in self.traced:
+                parent = parents.get(node)
+                if (
+                    isinstance(parent, ast.Attribute)
+                    and parent.value is node
+                    and parent.attr in _STATIC_SAFE_ATTRS
+                ):
+                    continue  # x.shape etc. — static under tracing
+                hits.append(node)
+        return hits
+
+    def visit_If(self, node: ast.If) -> None:  # noqa: N802 (ast API)
+        for name in self._traced_names_in_test(node.test):
+            self.findings.append(_finding(
+                self.ctx, "JX001", node,
+                f"Python 'if' on traced value {name.id!r} inside jitted "
+                f"{self.fn.name}() — use jnp.where/lax.cond or declare it "
+                "static",
+                self.symbol(),
+            ))
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:  # noqa: N802
+        for name in self._traced_names_in_test(node.test):
+            self.findings.append(_finding(
+                self.ctx, "JX001", node,
+                f"Python 'while' on traced value {name.id!r} inside jitted "
+                f"{self.fn.name}() — use lax.while_loop or declare it static",
+                self.symbol(),
+            ))
+        self.generic_visit(node)
+
+    # -- JX002: concretization calls ------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+        fn = node.func
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in _CONCRETIZERS
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in self.traced
+        ):
+            self.findings.append(_finding(
+                self.ctx, "JX002", node,
+                f"{fn.id}({node.args[0].id}) concretizes a traced value "
+                f"inside jitted {self.fn.name}()",
+                self.symbol(),
+            ))
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "item"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in self.traced
+        ):
+            self.findings.append(_finding(
+                self.ctx, "JX002", node,
+                f"{fn.value.id}.item() concretizes a traced value inside "
+                f"jitted {self.fn.name}()",
+                self.symbol(),
+            ))
+        self.generic_visit(node)
+
+    # -- JX003: mutable closure capture ---------------------------------------
+
+    def visit_Name(self, node: ast.Name) -> None:  # noqa: N802
+        if (
+            isinstance(node.ctx, ast.Load)
+            and node.id not in self._locals
+            and node.id in self.index.mutable_globals
+        ):
+            self.findings.append(_finding(
+                self.ctx, "JX003", node,
+                f"jitted {self.fn.name}() reads mutable module state "
+                f"{node.id!r} — captured at trace time and silently stale "
+                "afterwards; pass it as an argument",
+                self.symbol(),
+            ))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:  # noqa: N802
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and isinstance(node.ctx, ast.Load)
+        ):
+            self.findings.append(_finding(
+                self.ctx, "JX003", node,
+                f"jitted {self.fn.name}() reads self.{node.attr} — instance "
+                "state is captured at trace time; pass it as an argument or "
+                "mark the method static over a hashable self",
+                self.symbol(),
+            ))
+        self.generic_visit(node)
+
+
+def _check_static_defaults(ctx: FileContext, fn: ast.FunctionDef, statics: Tuple[Set[str], Set[int]]) -> List[Finding]:
+    findings: List[Finding] = []
+    static_names = _static_params(fn, statics)
+    args = fn.args
+    positional = args.posonlyargs + args.args
+    defaults: List[Tuple[str, ast.AST]] = []
+    for arg, default in zip(positional[len(positional) - len(args.defaults):], args.defaults):
+        defaults.append((arg.arg, default))
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            defaults.append((arg.arg, default))
+    for name, default in defaults:
+        if name in static_names and _is_mutable_literal(default):
+            findings.append(_finding(
+                ctx, "JX004", default,
+                f"static argument {name!r} of jitted {fn.name}() has a "
+                "mutable (unhashable) default — jit dispatch will raise",
+                fn.name,
+            ))
+    return findings
+
+
+class _CallSiteChecker(ast.NodeVisitor):
+    """JX004 at call sites: list/dict/set literals passed for static
+    params of same-module jitted functions."""
+
+    def __init__(self, ctx: FileContext, index: _ModuleIndex):
+        self.ctx = ctx
+        self.index = index
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else None
+        if name in self.index.jit_aliases:
+            name = self.index.jit_aliases[name]
+        if name in self.index.jitted and name in self.index.functions:
+            fndef = self.index.functions[name]
+            static_names = _static_params(fndef, self.index.jitted[name])
+            for kw in node.keywords:
+                if kw.arg in static_names and _is_mutable_literal(kw.value):
+                    self.findings.append(_finding(
+                        self.ctx, "JX004", kw.value,
+                        f"unhashable literal passed for static argument "
+                        f"{kw.arg!r} of jitted {name}()",
+                        name,
+                    ))
+        self.generic_visit(node)
+
+
+def check(ctx: FileContext) -> List[Finding]:
+    index = _ModuleIndex(ctx.tree)
+    findings: List[Finding] = []
+    for name, statics in index.jitted.items():
+        fn = index.functions.get(name)
+        if fn is None:
+            continue
+        checker = _JitBodyChecker(ctx, fn, statics, index)
+        checker.visit(fn)
+        findings.extend(checker.findings)
+        findings.extend(_check_static_defaults(ctx, fn, statics))
+    call_sites = _CallSiteChecker(ctx, index)
+    call_sites.visit(ctx.tree)
+    findings.extend(call_sites.findings)
+    return findings

@@ -27,7 +27,6 @@ from __future__ import annotations
 import logging
 import random
 import threading
-import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 

@@ -17,7 +17,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..ops.batch_solver import QueueSolve, solve_queue
 from ..ops.tensorize import (

@@ -31,7 +31,6 @@ the HTTP layer, the extender, and the write-back caches.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
 
 from . import deadline
 from .breaker import CircuitBreaker

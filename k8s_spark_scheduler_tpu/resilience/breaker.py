@@ -24,6 +24,8 @@ from __future__ import annotations
 import threading
 
 from .. import timesource
+from ..analysis import racecheck
+from ..analysis.guarded import guarded_by
 
 CLOSED = "closed"
 OPEN = "open"
@@ -32,6 +34,7 @@ HALF_OPEN = "half-open"
 _STATE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
 
 
+@guarded_by("_lock", "_state", "_consecutive_failures", "_opened_at", "_probe_in_flight")
 class CircuitBreaker:
     def __init__(
         self,
@@ -93,6 +96,7 @@ class CircuitBreaker:
 
     def record_failure(self) -> None:
         with self._lock:
+            racecheck.note_access(self, "_state")
             self._consecutive_failures += 1
             self._probe_in_flight = False
             if self._state == HALF_OPEN or (
@@ -135,7 +139,7 @@ class CircuitBreaker:
         # caller holds the lock
         if state == self._state:
             return
-        self._state = state
+        self._state = state  # schedlint: disable=LK001 -- private helper, every caller holds _lock (see callers)
         if self._metrics is not None:
             from ..metrics import names as mnames
 

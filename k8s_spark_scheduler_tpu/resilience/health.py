@@ -18,8 +18,6 @@ into an outage), but operators need to see it.  So:
 
 from __future__ import annotations
 
-from typing import Optional
-
 READY = "ready"
 DEGRADED = "degraded"
 UNREADY = "unready"

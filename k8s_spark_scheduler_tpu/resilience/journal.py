@@ -29,6 +29,9 @@ import os
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..analysis import racecheck
+from ..analysis.guarded import guarded_by
+
 logger = logging.getLogger(__name__)
 
 Key = Tuple[str, str]  # (namespace, name)
@@ -43,6 +46,7 @@ def _op_class(op: str) -> str:
     return "delete" if op == "delete" else _UPSERT
 
 
+@guarded_by("_lock", "_pending", "_seq", "_fh")
 class IntentJournal:
     def __init__(self, path: Optional[str] = None, metrics=None):
         self._path = path
@@ -82,17 +86,21 @@ class IntentJournal:
                         key = by_seq.get(seq)
                         if key is not None and pending.get(key, {}).get("seq") == seq:
                             pending.pop(key, None)
-        self._pending = pending
-        self._seq = max_seq
-        # compact: rewrite only the still-pending intents so the file
-        # doesn't grow across restarts
-        tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            for rec in pending.values():
-                f.write(json.dumps(rec, sort_keys=True) + "\n")
-        os.replace(tmp, self._path)
-        self._fh = open(self._path, "a")
-        self._report_depth()
+        # under the lock even though _load only runs from __init__: the
+        # lock is the declared guard for this state and holding it here
+        # keeps the discipline uniform
+        with self._lock:
+            self._pending = pending
+            self._seq = max_seq
+            # compact: rewrite only the still-pending intents so the file
+            # doesn't grow across restarts
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                for rec in pending.values():
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+            os.replace(tmp, self._path)
+            self._fh = open(self._path, "a")
+            self._report_depth()
 
     def _append_line(self, rec: dict) -> None:
         if self._fh is not None:
@@ -106,6 +114,7 @@ class IntentJournal:
     ) -> None:
         """Divert one write intent (latest-wins per key)."""
         with self._lock:
+            racecheck.note_access(self, "_pending")
             self._seq += 1
             rec = {
                 "a": "put",
@@ -131,6 +140,7 @@ class IntentJournal:
         the landed operation's class matches the pending intent's (an
         upsert landing must not ack a newer pending delete)."""
         with self._lock:
+            racecheck.note_access(self, "_pending")
             key = (namespace, name)
             rec = self._pending.get(key)
             if rec is None or _op_class(rec["op"]) != _op_class(op):

@@ -25,6 +25,8 @@ import threading
 from typing import Dict, List, Optional
 
 from .. import timesource
+from ..analysis import racecheck
+from ..analysis.guarded import guarded_by
 
 logger = logging.getLogger(__name__)
 
@@ -44,6 +46,7 @@ class _Lane:
         self.probe_in_flight = False
 
 
+@guarded_by("_lock", "_lanes")
 class LaneHealth:
     def __init__(
         self,
@@ -60,9 +63,10 @@ class LaneHealth:
         self._lanes: Dict[str, _Lane] = {}
 
     def _lane(self, name: str) -> _Lane:
+        racecheck.note_access(self, "_lanes")
         lane = self._lanes.get(name)
         if lane is None:
-            lane = self._lanes[name] = _Lane()
+            lane = self._lanes[name] = _Lane()  # schedlint: disable=LK001 -- private helper, every caller holds _lock
         return lane
 
     # -- dispatch-side -------------------------------------------------------

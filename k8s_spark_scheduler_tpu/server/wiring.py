@@ -36,7 +36,7 @@ from ..state.typed_caches import (
 )
 from ..tracing import Tracer
 from ..tracing import profiling as kernel_profiling
-from ..types.objects import Demand, Node, Pod, ResourceReservation
+from ..types.objects import Node, Pod, ResourceReservation
 
 
 @dataclass
@@ -91,11 +91,11 @@ class Server:
         polls for before kube-scheduler sends the first Filter."""
         import time as _time
 
-        deadline = _time.monotonic() + timeout
+        deadline = _time.monotonic() + timeout  # schedlint: disable=TS002 -- readiness-probe wait bounds real wall time for a live kubelet
         if not self.informer_factory.wait_for_cache_sync():
             return False
         ev = getattr(self, "_warm_done", None)
-        if ev is not None and not ev.wait(max(0.0, deadline - _time.monotonic())):
+        if ev is not None and not ev.wait(max(0.0, deadline - _time.monotonic())):  # schedlint: disable=TS002 -- remaining budget of the same real-time probe deadline
             return False
         return True
 
@@ -286,7 +286,7 @@ class Server:
     def stop(self) -> None:
         import time as _time
 
-        deadline = _time.monotonic() + 20.0  # headroom inside the k8s
+        deadline = _time.monotonic() + 20.0  # headroom inside the k8s  # schedlint: disable=TS002 -- shutdown grace period is real wall time granted by the kubelet
         # default 30s termination grace period, measured from stop() entry
         warm_thread = getattr(self, "_warm_thread", None)
         if warm_thread is not None:
@@ -305,7 +305,7 @@ class Server:
             # not stall shutdown past the grace period, so give up at the
             # deadline (the daemon flag then lets the process exit, at
             # worst uncleanly)
-            warm_thread.join(timeout=max(0.0, deadline - _time.monotonic()))
+            warm_thread.join(timeout=max(0.0, deadline - _time.monotonic()))  # schedlint: disable=TS002 -- remaining real-time budget of the shutdown grace period
             if warm_thread.is_alive():
                 import logging
 

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .. import timesource
+from ..analysis import racecheck
 from ..scheduler import labels as L
 from ..scheduler.failover import sync_resource_reservations_and_demands
 from ..testing.fake_autoscaler import FakeAutoscaler
@@ -120,6 +121,10 @@ class Simulation:
 
     def _build(self) -> None:
         sc = self.scenario
+        # under SCHEDLINT_RACECHECK=1 the sim doubles as a race hunt:
+        # the harness enables the detector before wiring the server, and
+        # chaos tests assert zero reports after the run
+        racecheck.enable_if_env()
         self.harness = Harness(
             binpack_algo=sc.binpack_algo,
             is_fifo=sc.fifo,

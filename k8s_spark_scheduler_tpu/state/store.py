@@ -16,6 +16,8 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis import racecheck
+from ..analysis.guarded import guarded_by
 from ..types.objects import APIObject
 
 Key = Tuple[str, str]  # (namespace, name)
@@ -54,6 +56,7 @@ def delete_request(key: Key) -> Request:
     return Request(key, DELETE)
 
 
+@guarded_by("_lock", "_store", "_observers")
 class ObjectStore:
     """Thread-safe map[(ns,name)] → object (store.go:27-130).
 
@@ -75,6 +78,7 @@ class ObjectStore:
         seeded before they existed (e.g. lister-seeded reservations on
         restart)."""
         with self._lock:
+            racecheck.note_access(self, "_observers")
             self._observers.append(fn)
             snapshot = list(self._store.values())
         for obj in snapshot:
@@ -99,6 +103,7 @@ class ObjectStore:
         process is the sole writer, so local RV is authoritative
         (store.go:51-59)."""
         with self._lock:
+            racecheck.note_access(self, "_store")
             key = key_of(obj)
             current = self._store.get(key)
             if current is not None:
@@ -110,6 +115,7 @@ class ObjectStore:
         """Fold an externally-observed object in: only bump our RV if the
         external one is numerically newer (store.go:62-76)."""
         with self._lock:
+            racecheck.note_access(self, "_store")
             key = key_of(obj)
             current = self._store.get(key)
             if current is None:
@@ -123,6 +129,7 @@ class ObjectStore:
 
     def put_if_absent(self, obj: APIObject) -> bool:
         with self._lock:
+            racecheck.note_access(self, "_store")
             key = key_of(obj)
             if key in self._store:
                 return False
@@ -150,6 +157,7 @@ class ObjectStore:
 
     def delete(self, key: Key) -> None:
         with self._lock:
+            racecheck.note_access(self, "_store")
             old = self._store.pop(key, None)
             if old is not None:
                 self._notify(old, None)
@@ -173,6 +181,7 @@ def fnv32a(data: bytes) -> int:
 ASYNC_REQUEST_BUFFER_SIZE = 100
 
 
+@guarded_by("_lock", "_inflight")
 class ShardedUniqueQueue:
     """queue.go:34-128.
 
@@ -227,6 +236,7 @@ class ShardedUniqueQueue:
 
     def _add_to_inflight_if_absent(self, key: Key) -> bool:
         with self._lock:
+            racecheck.note_access(self, "_inflight")
             if key in self._inflight:
                 return False
             self._inflight.add(key)
@@ -234,4 +244,5 @@ class ShardedUniqueQueue:
 
     def _delete_from_inflight(self, key: Key) -> None:
         with self._lock:
+            racecheck.note_access(self, "_inflight")
             self._inflight.discard(key)

@@ -12,6 +12,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..analysis.guarded import guarded_by
 from ..kube.apiserver import APIServer
 from ..kube.crd import DEMAND_CRD_NAME
 from ..kube.informer import Informer, InformerFactory
@@ -117,7 +118,7 @@ class ResourceReservationCache:
         if self._journal is None or self._journal.depth() == 0:
             return 0
         from ..types import serde
-        from .store import create_request, delete_request, update_request
+        from .store import create_request, update_request
 
         enqueued = 0
         for intent in self._journal.pending():
@@ -196,6 +197,7 @@ class DemandCache:
         return self._queue.queue_lengths()
 
 
+@guarded_by("_callback_lock", "_callbacks")
 class LazyDemandInformer:
     """internal/crd/demand_informer.go:40-138: polls for the Demand CRD to
     become Established, then starts the informer and signals ready."""
@@ -268,6 +270,7 @@ class LazyDemandInformer:
                 callback()
 
 
+@guarded_by("_lock", "_delegate")
 class SafeDemandCache:
     """internal/cache/safedemands.go:31-127: degrades to a no-op until the
     Demand CRD exists."""

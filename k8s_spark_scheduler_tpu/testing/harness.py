@@ -14,6 +14,7 @@ import time
 from typing import List, Optional, Sequence
 
 from .. import timesource
+from ..analysis import racecheck
 from ..config import FifoConfig, Install
 from ..kube.apiserver import APIServer
 from ..kube.crd import DEMAND_CRD_NAME, demand_crd_spec
@@ -40,6 +41,10 @@ class Harness:
         executor_prioritized_node_label=None,
         unschedulable_polling_interval: float = 60.0,
     ):
+        # SCHEDLINT_RACECHECK=1: activate the lockset race detector
+        # BEFORE any guarded shared state is constructed, so every lock
+        # the server wires up is tracked from birth
+        racecheck.enable_if_env()
         self.api = APIServer()
         if with_demand_crd:
             self.api.create_crd(DEMAND_CRD_NAME, demand_crd_spec())

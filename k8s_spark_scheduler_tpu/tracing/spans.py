@@ -21,6 +21,9 @@ from collections import deque
 from contextvars import ContextVar
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import timesource
+from ..analysis.guarded import guarded_by
+
 # the single active-span slot shared by every Tracer (see module doc)
 _CURRENT: ContextVar[Optional["Span"]] = ContextVar(
     "k8s_spark_scheduler_tpu_current_span", default=None
@@ -115,7 +118,8 @@ class Span:
     # -- context manager ------------------------------------------------------
 
     def __enter__(self) -> "Span":
-        self.start_time = time.time()
+        # semantic instant, not latency: sim traces carry virtual time
+        self.start_time = timesource.now()
         self._token = _CURRENT.set(self)
         self._t0 = time.perf_counter()
         return self
@@ -153,6 +157,7 @@ class _NoopSpan:
 NOOP_SPAN = _NoopSpan()
 
 
+@guarded_by("_lock", "_ring")
 class Tracer:
     """Span factory + bounded ring of completed traces.
 

@@ -1,0 +1,172 @@
+"""Runtime lockset race detector: a seeded race in a fixture class MUST
+be caught, the lock-disciplined twin must stay clean, and lock-order
+cycles must be recorded.  The 'real codebase runs clean' half of the
+acceptance lives in test_sim_chaos.py (detector active under fault
+injection)."""
+
+import threading
+
+import pytest
+
+from k8s_spark_scheduler_tpu.analysis import racecheck
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by, guarded_fields
+
+
+@guarded_by("_lock", "counts")
+class RacyCounter:
+    """Deliberately buggy: declares the guard but never takes it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = {}
+
+    def bump(self, key):  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+        racecheck.note_access(self, "counts")
+        value = self.counts.get(key, 0)
+        self.counts[key] = value + 1  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+
+
+@guarded_by("_lock", "counts")
+class SafeCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = {}
+
+    def bump(self, key):
+        with self._lock:
+            racecheck.note_access(self, "counts")
+            value = self.counts.get(key, 0)
+            self.counts[key] = value + 1
+
+
+@guarded_by("_lock")
+class LockHolder:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+
+@pytest.fixture
+def detector():
+    det = racecheck.enable(racecheck.RaceDetector())
+    try:
+        yield det
+    finally:
+        racecheck.disable()
+
+
+def _hammer(*counters, threads=4, iters=300):
+    def work():
+        for i in range(iters):
+            for c in counters:
+                c.bump("k")
+
+    ts = [threading.Thread(target=work, name=f"hammer-{i}") for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def test_seeded_race_is_caught_and_safe_twin_is_clean(detector):
+    racy, safe = RacyCounter(), SafeCounter()
+    _hammer(racy, safe)
+    racy_reports = [r for r in detector.races if "RacyCounter" in r.owner]
+    safe_reports = [r for r in detector.races if "SafeCounter" in r.owner]
+    assert racy_reports, "the seeded race went undetected"
+    assert racy_reports[0].field == "counts"
+    assert len(racy_reports[0].threads) >= 2
+    assert safe_reports == [], "lock-disciplined writes misreported as a race"
+
+
+def test_single_threaded_unlocked_writes_are_not_races(detector):
+    racy = RacyCounter()
+    for _ in range(100):
+        racy.bump("k")
+    assert detector.races == []  # Eraser's exclusive state: one thread only
+
+
+def test_lock_order_cycle_recorded(detector):
+    a, b = LockHolder(), LockHolder()
+    with a._lock:
+        with b._lock:
+            pass
+    assert detector.lock_order_violations == []
+    with b._lock:
+        with a._lock:
+            pass
+    assert len(detector.lock_order_violations) == 1
+    report = detector.lock_order_violations[0]
+    assert "LockHolder._lock" in str(report)
+    assert not detector.clean()
+
+
+def test_rlock_reentrancy_does_not_self_cycle(detector):
+    a = LockHolder()
+    with a._lock:
+        with a._lock:
+            pass
+    assert detector.lock_order_violations == []
+    # the held set is empty again afterwards
+    assert detector.held_lock_names() == frozenset()
+
+
+def test_note_access_is_noop_when_disabled():
+    assert not racecheck.active()
+    racy = RacyCounter()
+    _hammer(racy, threads=2, iters=50)  # must not blow up or record anything
+
+
+def test_instances_created_before_enable_are_skipped(detector):
+    # construct with the detector DISABLED: its lock is untracked and
+    # its accesses must be ignored rather than misreported as lock-free
+    racecheck.disable()
+    stale = SafeCounter()
+    racecheck.enable(detector)
+    _hammer(stale, threads=2, iters=50)
+    assert detector.races == []
+
+
+def test_instances_from_another_detector_are_skipped(detector):
+    # instrument under detector A, then judge under a fresh detector B:
+    # A's tracked lock reports to A's held stacks, so B must skip the
+    # instance entirely rather than see correctly-locked writes as
+    # lock-free
+    safe = SafeCounter()
+    assert isinstance(safe._lock, racecheck.TrackedLock)
+    fresh = racecheck.enable(racecheck.RaceDetector())
+    try:
+        _hammer(safe, threads=2, iters=50)
+        assert fresh.races == []
+    finally:
+        racecheck.enable(detector)  # restore so the fixture disables it
+
+
+def test_tracked_lock_locked_protocol(detector):
+    holder = LockHolder()  # RLock-backed: no .locked() before Python 3.14
+    assert holder._lock.locked() is False
+    with holder._lock:
+        assert holder._lock.locked() is True
+    assert holder._lock.locked() is False
+
+
+def test_guarded_registry_exposes_declarations():
+    lock_attr, fields = guarded_fields(SafeCounter)
+    assert lock_attr == "_lock"
+    assert fields == ("counts",)
+    assert guarded_fields(dict) == ("", ())
+
+
+def test_tracked_lock_wraps_on_construction(detector):
+    holder = LockHolder()
+    assert isinstance(holder._lock, racecheck.TrackedLock)
+    assert holder._schedlint_tracked
+    # acquire/release protocol still works through the proxy
+    assert holder._lock.acquire(blocking=False)
+    holder._lock.release()
+
+
+def test_report_lines_roundtrip(detector):
+    racy = RacyCounter()
+    _hammer(racy, threads=2)
+    lines = detector.report_lines()
+    assert any("unprotected shared write" in line for line in lines)

@@ -1,0 +1,262 @@
+"""schedlint: tier-1 self-check + analyzer unit tests.
+
+The self-check is the acceptance gate: the analyzer runs over the whole
+installed package in --strict mode and must report ZERO findings — every
+determinism, lock-discipline and tracer-safety invariant is permanent
+from this test's first green run onwards.
+"""
+
+import json
+
+import pytest
+
+from k8s_spark_scheduler_tpu.analysis import (
+    AnalysisConfig,
+    analyze_package,
+    analyze_paths,
+    load_allowlist,
+    render_json,
+    render_text,
+)
+from k8s_spark_scheduler_tpu.analysis.__main__ import main as cli_main
+from k8s_spark_scheduler_tpu.analysis.core import (
+    Finding,
+    extract_pragmas,
+    merge_allowlists,
+)
+
+
+# -- the tier-1 self-check ----------------------------------------------------
+
+
+def test_package_is_schedlint_clean_strict():
+    findings = analyze_package(AnalysisConfig(strict=True))
+    assert findings == [], "schedlint findings:\n" + render_text(findings)
+
+
+def test_cli_strict_exits_zero(capsys):
+    assert cli_main(["--strict"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_list_rules_covers_all_families(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("TS001", "TS002", "TS003", "DT001", "LK001", "LK002",
+                 "LK003", "JX001", "JX002", "JX003", "JX004", "PR001"):
+        assert rule in out
+
+
+# -- pragma suppression -------------------------------------------------------
+
+
+def _analyze_snippet(tmp_path, source, strict=False, use_default_allowlist=False,
+                     allowlist=None):
+    f = tmp_path / "snippet.py"
+    f.write_text(source)
+    config = AnalysisConfig(
+        strict=strict,
+        use_default_allowlist=use_default_allowlist,
+        allowlist=allowlist or {},
+    )
+    return analyze_paths([str(f)], config=config, root=str(tmp_path))
+
+
+BAD_TIME = "import time\n\ndef stamp():\n    return time.time()\n"
+
+
+def test_finding_without_pragma(tmp_path):
+    findings = _analyze_snippet(tmp_path, BAD_TIME)
+    assert [f.rule for f in findings] == ["TS001"]
+    assert findings[0].file == "snippet.py"
+    assert findings[0].line == 4
+
+
+def test_same_line_pragma_suppresses(tmp_path):
+    src = (
+        "import time\n\ndef stamp():\n"
+        "    return time.time()  # schedlint: disable=TS001 -- test fixture\n"
+    )
+    assert _analyze_snippet(tmp_path, src) == []
+
+
+def test_previous_line_pragma_suppresses(tmp_path):
+    src = (
+        "import time\n\ndef stamp():\n"
+        "    # schedlint: disable=TS001 -- test fixture\n"
+        "    return time.time()\n"
+    )
+    assert _analyze_snippet(tmp_path, src) == []
+
+
+def test_pragma_for_other_rule_does_not_suppress(tmp_path):
+    src = (
+        "import time\n\ndef stamp():\n"
+        "    return time.time()  # schedlint: disable=TS002 -- wrong rule\n"
+    )
+    assert [f.rule for f in _analyze_snippet(tmp_path, src)] == ["TS001"]
+
+
+def test_disable_all_pragma(tmp_path):
+    src = (
+        "import time\n\ndef stamp():\n"
+        "    return time.time()  # schedlint: disable=all -- test fixture\n"
+    )
+    assert _analyze_snippet(tmp_path, src) == []
+
+
+def test_strict_requires_justification(tmp_path):
+    src = (
+        "import time\n\ndef stamp():\n"
+        "    return time.time()  # schedlint: disable=TS001\n"
+    )
+    # lenient: pragma works, no complaint
+    assert _analyze_snippet(tmp_path, src, strict=False) == []
+    # strict: the unjustified pragma is itself a finding
+    findings = _analyze_snippet(tmp_path, src, strict=True)
+    assert [f.rule for f in findings] == ["PR001"]
+    assert "justification" in findings[0].message
+
+
+def test_extract_pragmas_parses_rules_and_why():
+    src = "x = 1  # schedlint: disable=TS001,LK002 -- because reasons\n"
+    (p,) = extract_pragmas(src)
+    assert p.rules == ("TS001", "LK002")
+    assert p.why == "because reasons"
+    assert p.line == 1
+    src2 = "# schedlint: disable=TS001\nx = 1\n"
+    (p2,) = extract_pragmas(src2)
+    assert p2.line == 2 and p2.pragma_line == 1 and p2.why is None
+
+
+# -- allowlist loading --------------------------------------------------------
+
+
+def test_allowlist_suppresses_by_path_prefix(tmp_path):
+    allow = {"TS001": [{"path": "snippet.py", "why": "test fixture"}]}
+    assert _analyze_snippet(tmp_path, BAD_TIME, allowlist=allow) == []
+    # a prefix that does not match leaves the finding
+    allow = {"TS001": [{"path": "other/", "why": "test fixture"}]}
+    assert len(_analyze_snippet(tmp_path, BAD_TIME, allowlist=allow)) == 1
+
+
+def test_load_allowlist_roundtrip(tmp_path):
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps({"TS002": [{"path": "x/", "why": "infra"}]}))
+    loaded = load_allowlist(str(path))
+    assert loaded == {"TS002": [{"path": "x/", "why": "infra"}]}
+
+
+def test_load_allowlist_rejects_missing_why(tmp_path):
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps({"TS002": [{"path": "x/"}]}))
+    with pytest.raises(ValueError, match="justification"):
+        load_allowlist(str(path))
+
+
+def test_load_allowlist_rejects_malformed(tmp_path):
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps(["not", "a", "dict"]))
+    with pytest.raises(ValueError):
+        load_allowlist(str(path))
+
+
+def test_merge_allowlists_concatenates_entries():
+    a = {"TS001": [{"path": "a", "why": "w"}]}
+    b = {"TS001": [{"path": "b", "why": "w"}], "LK001": [{"path": "c", "why": "w"}]}
+    merged = merge_allowlists(a, b)
+    assert [e["path"] for e in merged["TS001"]] == ["a", "b"]
+    assert "LK001" in merged
+
+
+# -- JSON reporter schema -----------------------------------------------------
+
+
+def test_json_reporter_schema_stable_keys(tmp_path):
+    findings = _analyze_snippet(tmp_path, BAD_TIME)
+    doc = json.loads(render_json(findings, strict=True))
+    assert set(doc) == {"schema_version", "tool", "strict", "findings", "counts"}
+    assert doc["schema_version"] == 1
+    assert doc["tool"] == "schedlint"
+    assert doc["strict"] is True
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "category", "file", "line", "col", "message", "symbol"}
+    assert doc["counts"]["total"] == 1
+    assert doc["counts"]["by_rule"] == {"TS001": 1}
+    assert doc["counts"]["by_category"] == {"determinism": 1}
+
+
+def test_json_reporter_empty_run():
+    doc = json.loads(render_json([]))
+    assert doc["findings"] == []
+    assert doc["counts"] == {"total": 0, "by_rule": {}, "by_category": {}}
+
+
+def test_json_output_is_deterministic(tmp_path):
+    findings = _analyze_snippet(tmp_path, BAD_TIME)
+    assert render_json(findings) == render_json(list(findings))
+
+
+def test_findings_sorted_by_location(tmp_path):
+    src = (
+        "import time\nimport random\n\n"
+        "def b():\n    return time.time()\n\n"
+        "def a():\n    return random.random()\n"
+    )
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["TS001", "DT001"]
+    assert findings == sorted(findings, key=Finding.sort_key)
+
+
+# -- representative rule behavior --------------------------------------------
+
+
+def test_lk001_respects_with_lock_scope(tmp_path):
+    src = """
+import threading
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+
+@guarded_by("_lock", "_state")
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def good(self, k):
+        with self._lock:
+            self._state[k] = 1
+
+    def bad(self, k):
+        self._state[k] = 1
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["LK001"]
+    assert findings[0].symbol == "C.bad"
+
+
+def test_jx001_static_args_not_flagged(tmp_path):
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def kern(x, flag=False):
+    if flag:          # static: fine
+        return x
+    if x.shape[0]:    # shape is static under tracing: fine
+        return x
+    if x > 0:         # traced: JX001
+        return x
+    return x
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["JX001"]
+
+
+def test_selecting_rule_families(tmp_path):
+    src = "import time\nimport random\nt = time.time()\nr = random.random()\n"
+    f = tmp_path / "snippet.py"
+    f.write_text(src)
+    config = AnalysisConfig(select=("DT",), use_default_allowlist=False)
+    findings = analyze_paths([str(f)], config=config, root=str(tmp_path))
+    assert [x.rule for x in findings] == ["DT001"]

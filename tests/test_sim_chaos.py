@@ -4,10 +4,16 @@ layer (ISSUE 3 acceptance).  An inline scenario combining
 churn faults) must complete with zero invariant violations (I1–I5 and
 the lost-intent checks J1/J2), zero lost reservation intents, a drained
 journal at the end, a byte-identical digest when re-run from the same
-seed, and bounded decision latency while degraded."""
+seed, and bounded decision latency while degraded.
+
+The same scenario also runs under the lockset race detector
+(``SCHEDLINT_RACECHECK=1``): fault injection exercises the write-back
+workers, journal replay, and lane-health probes concurrently, and the
+run must produce zero race reports and zero lock-order cycles."""
 
 import os
 
+from k8s_spark_scheduler_tpu.analysis import racecheck
 from k8s_spark_scheduler_tpu.sim import Scenario, Simulation
 
 _EXAMPLES = os.path.join(
@@ -105,6 +111,27 @@ def test_degraded_decision_latency_stays_bounded():
         f"degraded decision p99 {chaos_p99:.3f}ms exceeds budget "
         f"{budget:.3f}ms (unloaded baseline {clean_p99:.3f}ms)"
     )
+
+
+def test_chaos_scenario_runs_clean_under_race_detector(monkeypatch):
+    """The full degraded-mode chaos scenario with the Eraser-style
+    lockset detector instrumenting every guarded lock and shared-state
+    mutation: zero unprotected shared writes, zero lock-order cycles,
+    and the usual zero-violation audit still holds."""
+    monkeypatch.setenv(racecheck.ENV_FLAG, "1")
+    # the env flag is read by the harness/sim runner at build time; make
+    # sure no detector from another test is lingering
+    racecheck.disable()
+    try:
+        result = Simulation(Scenario.from_dict(_chaos_dict())).run()
+    finally:
+        detector = racecheck.disable()
+    assert result.violations == []
+    assert detector is not None, "the sim runner never enabled the detector"
+    assert detector._instances, "no guarded instances were instrumented"
+    assert detector.races == [], "\n".join(detector.report_lines())
+    assert detector.lock_order_violations == [], "\n".join(detector.report_lines())
+    assert detector.clean()
 
 
 def test_degraded_example_scenario_parses():
