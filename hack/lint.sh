@@ -10,6 +10,20 @@ rc=0
 echo "==> schedlint (python -m k8s_spark_scheduler_tpu.analysis --strict)"
 python -m k8s_spark_scheduler_tpu.analysis --strict || rc=1
 
+echo "==> native build (native/*.cpp compile + load, incl. the delta-solve session)"
+python - <<'PY' || rc=1
+from k8s_spark_scheduler_tpu.native import native_available
+from k8s_spark_scheduler_tpu.native.fifo import (
+    native_fifo_available,
+    native_session_available,
+)
+
+assert native_available(), "native/snapshot.cpp failed to build/load"
+assert native_fifo_available(), "native/fifo_solver.cpp failed to build/load"
+assert native_session_available(), "fifo session API missing from the built library"
+print("native libraries build and load (session API present)")
+PY
+
 if command -v ruff >/dev/null 2>&1; then
     echo "==> ruff check"
     ruff check k8s_spark_scheduler_tpu || rc=1

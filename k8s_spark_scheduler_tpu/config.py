@@ -110,6 +110,11 @@ class Install:
     # replicate the reference's accidental-but-load-bearing behaviors
     # (see compat.py for the list); off = corrected semantics
     strict_reference_parity: bool = compat.DEFAULT_STRICT
+    # incremental delta-solve engine (ops/deltasolve.py): persistent
+    # native solver sessions + prefix-feasibility reuse on the driver
+    # fast path.  Decisions are identical either way (the kill switch
+    # exists for operators, not semantics).
+    delta_solve: bool = True
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -179,5 +184,6 @@ class Install:
             strict_reference_parity=d.get(
                 "strict-reference-parity", compat.DEFAULT_STRICT
             ),
+            delta_solve=d.get("delta-solve", True),
             resilience=ResilienceConfig.from_dict(d.get("resilience", {})),
         )

@@ -82,6 +82,18 @@ RESILIENCE_LANE_STATE = "foundry.spark.scheduler.resilience.lane.state"
 RESILIENCE_HEALTH_STATE = "foundry.spark.scheduler.resilience.health.state"
 RESILIENCE_GATE_INFLIGHT = "foundry.spark.scheduler.resilience.gate.inflight"
 
+# delta-solve engine (ops/deltasolve.py): persistent native solver
+# sessions + prefix-feasibility reuse for the earlier-drivers-fit loop
+DELTASOLVE_WARM_HITS = "foundry.spark.scheduler.tpu.deltasolve.warm.hit.count"
+DELTASOLVE_WARM_MISSES = "foundry.spark.scheduler.tpu.deltasolve.warm.miss.count"
+DELTASOLVE_RESUME_DEPTH = "foundry.spark.scheduler.tpu.deltasolve.resume.depth"
+DELTASOLVE_SESSIONS = "foundry.spark.scheduler.tpu.deltasolve.sessions"
+DELTASOLVE_SESSION_BYTES = "foundry.spark.scheduler.tpu.deltasolve.session.bytes"
+
+# node-name interning + uniform-failure response cache (types/serde.py)
+SERDE_INTERN_HITS = "foundry.spark.scheduler.serde.names.intern.hit.count"
+SERDE_INTERN_MISSES = "foundry.spark.scheduler.serde.names.intern.miss.count"
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"

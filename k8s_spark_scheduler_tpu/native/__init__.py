@@ -91,6 +91,17 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p,
                 ctypes.c_void_p,
             ]
+            try:
+                # optional (older prebuilt .so may lack it; rows_equal
+                # then uses the numpy fallback)
+                lib.snap_rows_diff.restype = ctypes.c_int64
+                lib.snap_rows_diff.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                ]
+            except AttributeError:
+                pass
             _lib = lib
         except Exception:
             logger.warning("native snapshot library unavailable; using numpy fallback",
@@ -184,6 +195,28 @@ class SnapshotMaintainer:
             )
             return bool(ok), out_avail, out_demands[:n_demands], out_scale
         return _numpy_scale_int32(self._np, demand_rows, node_bucket)
+
+
+def rows_equal(a: np.ndarray, b: np.ndarray) -> bool:
+    """Exact equality of two [n, 3] int64 row blocks — the delta-solve
+    engine's warm-basis check.  Native memcmp when the library carries
+    snap_rows_diff, numpy otherwise; both are exact."""
+    a = np.ascontiguousarray(a, dtype=np.int64)
+    b = np.ascontiguousarray(b, dtype=np.int64)
+    if a.shape != b.shape:
+        return False
+    n = a.shape[0]
+    if n == 0:
+        return True
+    lib = _build_and_load()
+    if lib is not None and hasattr(lib, "snap_rows_diff"):
+        diff = lib.snap_rows_diff(
+            a.ctypes.data_as(ctypes.c_void_p),
+            b.ctypes.data_as(ctypes.c_void_p),
+            n,
+        )
+        return diff < 0
+    return bool(np.array_equal(a, b))
 
 
 def scale_rows_int32(avail_rows: np.ndarray, demand_rows: np.ndarray, node_bucket: int):

@@ -47,6 +47,9 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                     # IEEE semantics preserved; only errno/trap
                     # bookkeeping dropped so divpd vectorizes cleanly
                     "-fno-math-errno", "-fno-trapping-math",
+                    # the delta-solve session's sharded cold pass runs a
+                    # small std::thread pool
+                    "-pthread",
                 ],
             )
             lib.fifo_solve_queue.restype = ctypes.c_int
@@ -71,12 +74,37 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 lib.seq_sum_f64.argtypes = [_P, ctypes.c_int64]
             except AttributeError:
                 pass
+            try:
+                lib.seq_sum_f64_plain.restype = ctypes.c_double
+                lib.seq_sum_f64_plain.argtypes = [_P, ctypes.c_int64]
+            except AttributeError:
+                pass
             lib.fifo_solve_queue_single_az.restype = ctypes.c_int
             lib.fifo_solve_queue_single_az.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _P, _P, _P,
                 _P, _P, _P, _P, _P, _P, _P, ctypes.c_int, ctypes.c_int,
                 ctypes.c_int, _P, _P, _P,
             ]
+            try:
+                # delta-solve session API (PR 5) — optional for the same
+                # prebuilt-library reason as seq_sum_f64
+                lib.fifo_sess_create.restype = _P
+                lib.fifo_sess_create.argtypes = []
+                lib.fifo_sess_destroy.restype = None
+                lib.fifo_sess_destroy.argtypes = [_P]
+                lib.fifo_sess_load.restype = ctypes.c_int
+                lib.fifo_sess_load.argtypes = [
+                    _P, ctypes.c_int64, _P, _P, _P, ctypes.c_int,
+                    ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+                ]
+                lib.fifo_sess_solve.restype = ctypes.c_int64
+                lib.fifo_sess_solve.argtypes = [
+                    _P, ctypes.c_int64, _P, _P, _P, _P,
+                ]
+                lib.fifo_sess_mem_bytes.restype = ctypes.c_int64
+                lib.fifo_sess_mem_bytes.argtypes = [_P]
+            except AttributeError:
+                pass
             _lib = lib
         except Exception:
             logger.warning(
@@ -211,14 +239,128 @@ def solve_queue_single_az_native(
 
 
 def seq_sum_f64_native(values: np.ndarray) -> Optional[float]:
-    """CPython-sum-compatible float64 reduction (bit-identical to
-    builtin sum() of the list — Neumaier since 3.12) or None when the
-    lib (or the symbol, in an older prebuilt) is unavailable."""
+    """CPython-sum-compatible float64 reduction — bit-identical to
+    builtin sum() of the list on THIS interpreter (Neumaier-compensated
+    since 3.12, plain left-to-right before), or None when the lib (or
+    the needed symbol, in an older prebuilt) is unavailable.
+
+    The gauge path now uses :func:`neumaier_sum_f64_native` instead
+    (its contract is cross-lane order-robustness, not builtin parity);
+    this wrapper remains the drop-in for any host loop of the form
+    ``sum(list)`` a lane wants to move to C without changing a bit."""
+    import sys
+
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    symbol = "seq_sum_f64" if sys.version_info >= (3, 12) else "seq_sum_f64_plain"
+    if not hasattr(lib, symbol):
+        return None
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    return float(getattr(lib, symbol)(_c(v), v.shape[0]))
+
+
+def neumaier_sum_f64_native(values: np.ndarray) -> Optional[float]:
+    """Neumaier-compensated float64 sum (the seq_sum_f64 symbol,
+    interpreter-independent): the packing-efficiency gauge uses this
+    because its cross-lane bit-equality contract needs an order-robust
+    sum — the host lane accumulates the same per-node maxes in metadata
+    order, the tensor lanes in node-priority order, and compensation
+    recovers the same rounded value where plain sequential addition
+    diverges by an ulp.  None when unavailable."""
     lib = _build_and_load()
     if lib is None or not hasattr(lib, "seq_sum_f64"):
         return None
     v = np.ascontiguousarray(values, dtype=np.float64)
     return float(lib.seq_sum_f64(_c(v), v.shape[0]))
+
+
+# queue policy codes shared with native/fifo_solver.cpp::FifoSession
+POLICY_TIGHTLY = 0
+POLICY_EVENLY = 1
+POLICY_MINFRAG = 2
+
+
+def native_session_available() -> bool:
+    lib = _build_and_load()
+    return lib is not None and hasattr(lib, "fifo_sess_create")
+
+
+class NativeFifoSession:
+    """Persistent native solver session: the scaled availability basis,
+    the rank-sorted driver candidates, and the prefix-feasibility
+    checkpoints stay resident in the C++ extension between Filter
+    requests (fifo_solver.cpp ``fifo_sess_*``).
+
+    ``solve`` self-verifies the queue prefix byte-for-byte inside the
+    extension, so callers may pass whatever they believe the queue is —
+    a wrong belief costs a deeper re-solve, never a wrong decision.
+    Not thread-safe; the owning engine serializes access."""
+
+    def __init__(self, threads: int = 0, min_pool_nodes: int = 8192):
+        lib = _build_and_load()
+        if lib is None or not hasattr(lib, "fifo_sess_create"):
+            raise RuntimeError("native fifo session not available")
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.fifo_sess_create())
+        if not self._handle:
+            raise RuntimeError("fifo_sess_create failed")
+        self._threads = int(threads)
+        self._min_pool_nodes = int(min_pool_nodes)
+        self.nb = 0
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.fifo_sess_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def load(
+        self,
+        avail: np.ndarray,        # [Nb, 3] int32 scaled basis
+        driver_rank: np.ndarray,  # [Nb] int32
+        exec_ok: np.ndarray,      # [Nb] bool
+        policy: int,
+        stride: int = 64,
+    ) -> None:
+        av = np.ascontiguousarray(avail, dtype=np.int32)
+        rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+        eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+        nb = av.shape[0]
+        ok = self._lib.fifo_sess_load(
+            self._handle, nb, _c(av), _c(rank), _c(eok), int(policy),
+            int(stride), self._threads, self._min_pool_nodes,
+        )
+        if not ok:
+            raise RuntimeError("fifo_sess_load failed")
+        self.nb = int(nb)
+
+    def solve(
+        self, apps_packed: np.ndarray  # [A, 8] int32: d0..2 e0..2 count valid
+    ) -> Tuple[int, np.ndarray, np.ndarray, np.ndarray]:
+        """(resume_index, feasible[A] bool, driver_idx[A] int32,
+        avail_after[Nb, 3] int32)."""
+        apps = np.ascontiguousarray(apps_packed, dtype=np.int32)
+        na = apps.shape[0]
+        feas = np.zeros(max(na, 1), dtype=np.uint8)
+        didx = np.zeros(max(na, 1), dtype=np.int32)
+        avail_after = np.zeros((self.nb, 3), dtype=np.int32)
+        resume = self._lib.fifo_sess_solve(
+            self._handle, na, _c(apps), _c(feas), _c(didx), _c(avail_after)
+        )
+        if resume < 0:
+            raise RuntimeError("fifo_sess_solve on an unloaded session")
+        return int(resume), feas[:na].astype(bool), didx[:na], avail_after
+
+    def mem_bytes(self) -> int:
+        if not getattr(self, "_handle", None):
+            return 0
+        return int(self._lib.fifo_sess_mem_bytes(self._handle))
 
 
 def solve_app_native(

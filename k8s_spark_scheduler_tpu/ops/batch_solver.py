@@ -345,6 +345,23 @@ def mf_sentinel_safe(avail) -> bool:
     return a.size == 0 or int(a.max()) <= MF_SENT - 1
 
 
+# queue-scan assignment policies every whole-queue lane implements (the
+# XLA scan, the pallas kernel, the native C++ solver, and the native
+# delta-solve session — native/fifo_solver.cpp::FifoSession uses these
+# exact integer codes); single-AZ policies are a separate solver family
+QUEUE_POLICY_CODES = {
+    "tightly-pack": 0,
+    "distribute-evenly": 1,
+    "minimal-fragmentation": 2,
+}
+
+
+def queue_policy_code(assignment_policy: str):
+    """Native session policy code for a TpuFifoSolver assignment policy,
+    or None when no whole-queue session lane serves it."""
+    return QUEUE_POLICY_CODES.get(assignment_policy)
+
+
 @functools.partial(jax.jit, static_argnames=("with_placements",))
 def solve_queue_min_frag(
     avail: jnp.ndarray,      # [N, 3] int32

@@ -152,21 +152,36 @@ class LazyEfficiencies(dict):
         return [(n, self[n]) for n in self._names]
 
     def seq_max_avg(self) -> float:
-        """sum(max(gpu, cpu, memory)) / n with the same float64
-        sequential-sum semantics as iterating the dict values (the
-        extender's packing-efficiency gauge)."""
+        """sum(max(gpu, cpu, memory)) / n for the extender's
+        packing-efficiency gauge, Neumaier-compensated: the gauge's
+        cross-lane bit-equality contract (test_extender_efficiency_
+        gauge_matches_host_lane) sums the same per-node maxes in
+        different orders on different lanes, and compensation makes the
+        rounded result order-robust — exact whenever the true sum is
+        representable, which plain left-to-right addition is not (the
+        host lane's uncompensated loop can land an ulp off in ITS order;
+        compensation recovers the representable value either way)."""
         if not self._names:
             return 0.0
         maxes = np.maximum(np.maximum(self._cpu, self._mem), self._gpu)
         try:
-            from ..native.fifo import seq_sum_f64_native
+            from ..native.fifo import neumaier_sum_f64_native
 
-            total = seq_sum_f64_native(maxes)
+            total = neumaier_sum_f64_native(maxes)
         except Exception:
             total = None
         if total is None:
-            # same IEEE order, Python speed (~0.6ms at 10k nodes)
-            total = sum(maxes.tolist())
+            # same algorithm at Python speed (native lane unavailable)
+            s = 0.0
+            c = 0.0
+            for x in maxes.tolist():
+                t = s + x
+                if abs(s) >= abs(x):
+                    c += (s - t) + x
+                else:
+                    c += (x - t) + s
+                s = t
+            total = s + c
         return total / float(len(self._names))
 
 
@@ -370,7 +385,7 @@ class TpuFifoSolver:
         Quantity-based efficiency computation when provided)."""
         import jax.numpy as jnp
 
-        from .batch_solver import solve_queue, solve_queue_min_frag, solve_single
+        from .batch_solver import solve_queue, solve_queue_min_frag
 
         apps = self._tensorize_with_cache(list(earlier_apps), current_app)
         self.last_queue_lane = None
@@ -495,6 +510,32 @@ class TpuFifoSolver:
             with tracing.child_span("fifo_gate", {"earlierApps": 0, "earlierOk": True}):
                 avail_after = problem.avail if use_native else jnp.asarray(problem.avail)
 
+        return self._pack_current(
+            cluster, problem, avail_after, n_earlier, current_app,
+            metadata=metadata, use_native=use_native,
+        )
+
+    def _pack_current(
+        self,
+        cluster,
+        problem,
+        avail_after,
+        n_earlier: int,
+        current_app: AppDemand,
+        metadata: Optional[NodeGroupSchedulingMetadata] = None,
+        use_native: bool = False,
+    ) -> FifoOutcome:
+        """The current driver's gang pack against the post-queue
+        availability carry: solve + placement decode + efficiency rows.
+        Shared tail of solve_tensor and the delta-solve engine
+        (ops/deltasolve.py), which substitutes its session's warm carry
+        for the cold queue pass and hands the identical arguments here."""
+        import jax.numpy as jnp
+
+        from .batch_solver import solve_single
+
+        evenly = self.assignment_policy == "distribute-evenly"
+        minfrag = self.assignment_policy == "minimal-fragmentation"
         with tracing.child_span(
             "binpack", {"policy": self.assignment_policy}
         ) as binpack_span:

@@ -109,6 +109,7 @@ class SparkSchedulerExtender:
         strict_reference_parity: bool = compat.DEFAULT_STRICT,
         tracer: Optional[tracing.Tracer] = None,
         resilience=None,
+        delta_solve: bool = True,
     ):
         self._node_informer = node_informer
         self._pod_lister = pod_lister
@@ -137,6 +138,17 @@ class SparkSchedulerExtender:
         # threaded HTTP front end can't interleave predicates
         self._predicate_lock = threading.Lock()
         self._fast_path_ok = tensor_snapshot_cache is not None
+        # incremental delta-solve engine (ops/deltasolve.py): persistent
+        # native solver sessions + prefix-feasibility reuse for the
+        # earlier-drivers pass.  None when disabled or when there is no
+        # tensor mirror to key invalidation on; the engine itself
+        # declines (returns None) per request when it can't serve
+        # exactly, so construction is cheap and unconditional otherwise.
+        self.delta_engine = None
+        if delta_solve and tensor_snapshot_cache is not None:
+            from ..ops.deltasolve import DeltaSolveEngine
+
+            self.delta_engine = DeltaSolveEngine(metrics=self._metrics)
         self._strict_reference_parity = strict_reference_parity
         self._resilience = resilience
         self._lane_health = resilience.lanes if resilience is not None else None
@@ -302,7 +314,13 @@ class SparkSchedulerExtender:
     def _fail_with_message(self, outcome: str, args: ExtenderArgs, message: str) -> ExtenderFilterResult:
         if self._waste_reporter is not None:
             self._waste_reporter.mark_failed_scheduling_attempt(args.pod, outcome)
-        return ExtenderFilterResult(failed_nodes={n: message for n in args.node_names})
+        # the uniform_failure hint lets the HTTP layer reuse an encoded
+        # response buffer for this (candidate tuple, message) pair
+        # instead of re-serializing a 10k-entry map per retry
+        return ExtenderFilterResult(
+            failed_nodes={n: message for n in args.node_names},
+            uniform_failure=(args.node_names, message),
+        )
 
     def _reconcile_if_needed(self) -> None:
         """resource.go:194-205."""
@@ -508,18 +526,6 @@ class SparkSchedulerExtender:
             from ..ops.sparkapp import AppDemand
 
             snap = self._tensor_snapshot.snapshot()
-            with self._tracer.span("fast_path.build_tensor") as sp:
-                built = build_cluster_tensor(
-                    snap,
-                    driver,
-                    list(node_names),
-                    driver_label_priority=self._node_sorter.driver_label_priority,
-                    executor_label_priority=self._node_sorter.executor_label_priority,
-                )
-                sp.tag("exact", built is not None)
-            if built is None:
-                return self._lane_neutral("tensor_driver")
-            cluster, zones = built
 
             earlier_apps = []
             skip_allowed = []
@@ -538,15 +544,49 @@ class SparkSchedulerExtender:
                         continue
                     earlier_apps.append(demand)
                     skip_allowed.append(queued.creation_timestamp > skip_cutoff)
+            current = AppDemand(
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+            )
+
+            # incremental lane first: a warm session skips the tensor
+            # build, the sorts, the GCD scaling, AND the already-proved
+            # queue prefix — the engine declines (None) whenever it
+            # cannot serve the request exactly
+            if self.delta_engine is not None:
+                served = self.delta_engine.solve(
+                    snap, driver, node_names, self._node_sorter,
+                    earlier_apps, skip_allowed, current, solver,
+                )
+                if served is not None:
+                    outcome, zones = served
+                    if self._lane_health is not None:
+                        self._lane_health.record_success(
+                            "tensor_driver", time.perf_counter() - t0
+                        )
+                    return outcome, zones
+
+            with self._tracer.span("fast_path.build_tensor") as sp:
+                # node_names flows through verbatim — on the HTTP path
+                # it is the interned tuple, so prep-cache keys share one
+                # string set instead of pinning per-request copies
+                built = build_cluster_tensor(
+                    snap,
+                    driver,
+                    node_names,
+                    driver_label_priority=self._node_sorter.driver_label_priority,
+                    executor_label_priority=self._node_sorter.executor_label_priority,
+                )
+                sp.tag("exact", built is not None)
+            if built is None:
+                return self._lane_neutral("tensor_driver")
+            cluster, zones = built
             outcome = solver.solve_tensor(
                 cluster,
                 earlier_apps,
                 skip_allowed,
-                AppDemand(
-                    app_resources.driver_resources,
-                    app_resources.executor_resources,
-                    app_resources.min_executor_count,
-                ),
+                current,
             )
             if not outcome.supported:
                 return self._lane_neutral("tensor_driver")

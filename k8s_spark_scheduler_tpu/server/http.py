@@ -274,7 +274,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": f"bad ExtenderArgs: {err}"})
                 return
             result = self._predicate_guarded(args)
-            self._send_json(200, serde.extender_filter_result_to_dict(result))
+            # encoded uniform failures come from a reusable buffer pool
+            # (serde.encode_extender_filter_result) — the 10k-entry
+            # FailedNodes map serializes once per (candidates, message)
+            self._send_bytes(
+                200,
+                serde.encode_extender_filter_result(result),
+                "application/json",
+            )
         elif self.path == "/convert":
             self._send_json(200, convert_review(body))
         else:
@@ -300,10 +307,10 @@ class _Handler(BaseHTTPRequestHandler):
             span = tracing.current_span()
             if span is not None:
                 span.tag("outcome", "shed")
+            message = "scheduler overloaded; retry"
             return ExtenderFilterResult(
-                failed_nodes={
-                    n: "scheduler overloaded; retry" for n in args.node_names
-                }
+                failed_nodes={n: message for n in args.node_names},
+                uniform_failure=(args.node_names, message),
             )
 
 

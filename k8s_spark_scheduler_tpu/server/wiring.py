@@ -330,6 +330,11 @@ def init_server_with_clients(
     # correct for the one-server-per-process production shape.
     tracer = Tracer(capacity=256, metrics=metrics)
     kernel_profiling.default_profiler.configure(metrics=metrics, tracer=tracer)
+    # node-name interning counters land in THIS server's registry (the
+    # interner is module-level for the same reason the profiler is)
+    from ..types import serde as _serde
+
+    _serde.names_interner.metrics = metrics
 
     # CRD ensure (cmd/server.go:83-85)
     crd.ensure_resource_reservations_crd(
@@ -425,6 +430,7 @@ def init_server_with_clients(
         strict_reference_parity=install.strict_reference_parity,
         tracer=tracer,
         resilience=resilience_kit,
+        delta_solve=install.delta_solve,
     )
     marker = UnschedulablePodMarker(
         api,

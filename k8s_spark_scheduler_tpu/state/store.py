@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..analysis import racecheck
 from ..analysis.guarded import guarded_by
@@ -165,6 +166,71 @@ class ObjectStore:
     def list(self) -> List[APIObject]:
         with self._lock:
             return list(self._store.values())
+
+
+# -- change feed --------------------------------------------------------------
+#
+# Typed delta kinds published by the state layer's incremental mirrors
+# (the tensor snapshot publishes one per mutation it absorbs).  The
+# delta-solve engine (ops/deltasolve.py) consumes the SEQUENCE: an
+# unchanged sequence number proves NOTHING changed (the O(1) warm
+# check); on a changed sequence it goes straight to the exact content
+# compare, which subsumes any kind-level filtering (every published
+# kind can move availability).  The typed ring behind ``kinds_since``
+# is the introspection surface — tests assert on it and operators can
+# read what moved when debugging an unexpected cold solve.
+
+DELTA_RESERVATION = "reservation"
+DELTA_SOFT_RESERVATION = "soft-reservation"
+DELTA_NODE = "node"
+DELTA_NODE_STRUCTURE = "node-structure"
+DELTA_POD = "pod"
+
+
+@guarded_by("_lock", "_seq", "_ring")
+class ChangeFeed:
+    """Monotonic, bounded feed of typed state deltas.
+
+    ``publish`` assigns the next sequence number under the lock; the
+    sequence is the feed's only truth — consumers cache the seq they
+    last verified against and treat an unchanged seq as proof of an
+    unchanged world.  ``kinds_since`` answers "which delta kinds landed
+    after seq" from a bounded ring, or ``None`` once seq has fallen off
+    the ring; it exists for introspection (tests, debugging a cold
+    solve), not invalidation — the engine's content compare already
+    subsumes kind-level filtering."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._seq = 0
+        # (seq, kind, key) — key is a debugging affordance, never
+        # consulted for invalidation decisions
+        self._ring: Deque[Tuple[int, str, Optional[str]]] = deque(
+            maxlen=capacity
+        )
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def publish(self, kind: str, key: Optional[str] = None) -> int:
+        with self._lock:
+            racecheck.note_access(self, "_seq")
+            self._seq += 1
+            self._ring.append((self._seq, kind, key))
+            return self._seq
+
+    def kinds_since(self, seq: int):
+        """frozenset of delta kinds with sequence > seq, or None when
+        the ring no longer reaches back that far."""
+        with self._lock:
+            if seq >= self._seq:
+                return frozenset()
+            oldest = self._ring[0][0] if self._ring else self._seq + 1
+            if seq + 1 < oldest:
+                return None
+            return frozenset(k for s, k, _ in self._ring if s > seq)
 
 
 def fnv32a(data: bytes) -> int:

@@ -43,6 +43,14 @@ from ..scheduler import labels as L
 from ..scheduler.overhead import pod_to_resources
 from ..types.objects import Node, Pod
 from ..types.resources import ZONE_LABEL, ZONE_LABEL_PLACEHOLDER
+from .store import (
+    DELTA_NODE,
+    DELTA_NODE_STRUCTURE,
+    DELTA_POD,
+    DELTA_RESERVATION,
+    DELTA_SOFT_RESERVATION,
+    ChangeFeed,
+)
 
 _GROW = 256
 
@@ -78,6 +86,12 @@ class TensorSnapshot:
     # work (ops/fast_path._build_prep) across Filter requests
     structure_key: tuple = (-1, -1)
 
+    # (maintainer instance, change-feed sequence): changes on EVERY
+    # mutation the mirror absorbs — an equal content_key across two
+    # snapshots proves their contents are identical, so consumers
+    # (ops/deltasolve.py) can skip even the content compare
+    content_key: tuple = (-1, -1)
+
     _name_index: Optional[Dict[str, int]] = None
 
     @property
@@ -110,6 +124,11 @@ class TensorSnapshotCache:
         self._structure_rev = 0
         # snapshot()'s structure-derived parts, keyed by _structure_rev
         self._struct_cache = None
+        # monotonic typed-delta feed: every mutation this mirror absorbs
+        # publishes one delta (under the mirror lock, so a snapshot
+        # taken under the same lock sees a consistent sequence); the
+        # delta-solve engine keys its warm-path checks on the sequence
+        self.feed = ChangeFeed()
 
         # node table
         self._node_slot: Dict[str, int] = {}
@@ -202,6 +221,9 @@ class TensorSnapshotCache:
                 # structural change only: allocatable/status heartbeats
                 # must not invalidate structure-keyed consumer caches
                 self._structure_rev += 1
+                self.feed.publish(DELTA_NODE_STRUCTURE, node.name)
+            else:
+                self.feed.publish(DELTA_NODE, node.name)
             if slot is None:
                 slot = self._free_nodes.pop() if self._free_nodes else self._grow_nodes()
                 self._node_slot[node.name] = slot
@@ -222,6 +244,7 @@ class TensorSnapshotCache:
     def _on_node_delete(self, node: Node) -> None:
         with self._lock:
             self._structure_rev += 1
+            self.feed.publish(DELTA_NODE_STRUCTURE, node.name)
             slot = self._node_slot.pop(node.name, None)
             if slot is None:
                 return
@@ -292,6 +315,10 @@ class TensorSnapshotCache:
                 for pod_name in new.status.pods.values():
                     self._reserved_pods.add((new.namespace, pod_name))
             self._pods_dirty = True
+            ref = new if new is not None else old
+            self.feed.publish(
+                DELTA_RESERVATION, ref.name if ref is not None else None
+            )
 
     def _on_soft_change(self, node: str, resources, sign: int, pod_name: str) -> None:
         with self._lock:
@@ -305,6 +332,7 @@ class TensorSnapshotCache:
             else:
                 self._soft_reserved_names[pod_name] = count
             self._pods_dirty = True
+            self.feed.publish(DELTA_SOFT_RESERVATION, pod_name)
 
     # -- pod table (overhead) ------------------------------------------------
 
@@ -325,6 +353,10 @@ class TensorSnapshotCache:
                 if slot is not None:
                     self._pod_active[slot] = False
                     self._pods_dirty = True
+                    self.feed.publish(DELTA_POD, pod.name)
+                # a nodeless pod the mirror never tracked changes no
+                # state: queued-driver heartbeats must not churn the
+                # content sequence (they arrive on every Filter cycle)
                 return
             if slot is None:
                 slot = self._free_pods.pop() if self._free_pods else self._grow_pods()
@@ -336,6 +368,7 @@ class TensorSnapshotCache:
             self._pod_requests[slot] = row
             self._pod_node_name[slot] = pod.node_name
             self._pod_active[slot] = True
+            self.feed.publish(DELTA_POD, pod.name)
             if pod.labels.get(L.SPARK_ROLE_LABEL) == L.EXECUTOR and pod.is_terminated():
                 # terminated pods keep informer entries but the reference
                 # counts them via the lister; overhead counts any pod whose
@@ -353,7 +386,10 @@ class TensorSnapshotCache:
                 self._pod_key_of_slot.pop(slot, None)
                 self._free_pods.append(slot)
                 self._pods_dirty = True
+            was_reserved = (pod.namespace, pod.name) in self._reserved_pods
             self._reserved_pods.discard((pod.namespace, pod.name))
+            if slot is not None or was_reserved:
+                self.feed.publish(DELTA_POD, pod.name)
 
     # -- snapshot ------------------------------------------------------------
 
@@ -447,4 +483,7 @@ class TensorSnapshotCache:
                 res_entries=self._res_count[idx] > 0,  # comparison allocates fresh
                 name_rank=ranks,
                 structure_key=(self._instance_id, self._structure_rev),
+                # feed.seq is stable here: every publisher holds this
+                # mirror's lock, which snapshot() also holds
+                content_key=(self._instance_id, self.feed.seq),
             )

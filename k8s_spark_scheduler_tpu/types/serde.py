@@ -12,8 +12,12 @@ Demand v1alpha1 ↔ v1alpha2 (flat resources vs resource list).
 from __future__ import annotations
 
 import json
+import threading
+from collections import OrderedDict
 from typing import Any, Dict, List
 
+from ..analysis import racecheck
+from ..analysis.guarded import guarded_by
 from ..utils.quantity import Quantity
 from .extenderapi import ExtenderArgs, ExtenderFilterResult
 from .objects import (
@@ -311,12 +315,174 @@ def node_from_dict(d: dict) -> Node:
 def extender_args_from_dict(d: dict) -> ExtenderArgs:
     return ExtenderArgs(
         pod=pod_from_dict(d.get("Pod") or d.get("pod") or {}),
-        node_names=list(d.get("NodeNames") or d.get("nodeNames") or []),
+        node_names=intern_node_names(
+            list(d.get("NodeNames") or d.get("nodeNames") or [])
+        ),
     )
 
 
 def extender_filter_result_to_dict(result: ExtenderFilterResult) -> dict:
     return result.to_dict()
+
+
+# -- node-name interning + response-buffer reuse ------------------------------
+#
+# kube-scheduler sends the SAME candidate node-name list (10k strings,
+# ~200KB of JSON) on every Filter request, and the extender's failure
+# responses serialize a FailedNodes map over that same list with one
+# shared message.  Interning the parsed list gives every downstream
+# consumer a stable tuple object: identity-keyed caches (the uniform
+# failure-response encoder below) become exact, the per-request garbage
+# of 10k strings disappears, and the fast-path prep key's candidate
+# tuple is shared instead of rebuilt.  Correctness never rests on the
+# fingerprint: a candidate is returned only after a full element-wise
+# compare (C-speed list/tuple equality), so a fingerprint collision
+# costs a compare, not a wrong candidate list.
+
+
+@guarded_by("_lock", "_entries", "hits", "misses")
+class NodeNamesInterner:
+    """Bounded exact-verified intern pool for candidate node-name lists.
+
+    Bounded on BOTH axes: at most MAX_ENTRIES distinct fingerprints, and
+    at most MAX_PER_BUCKET variants per fingerprint — interior node
+    churn that keeps (len, first, last, middle) stable must rotate a
+    bucket, not grow it."""
+
+    MAX_ENTRIES = 8
+    MAX_PER_BUCKET = 4
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # fingerprint → list of interned tuples sharing it
+        self._entries: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.metrics = None  # optional registry, set by server wiring
+
+    @staticmethod
+    def _fingerprint(names) -> tuple:
+        n = len(names)
+        if n == 0:
+            return (0,)
+        return (n, names[0], names[-1], names[n // 2])
+
+    def intern(self, names: list) -> tuple:
+        incoming = tuple(names)
+        fp = self._fingerprint(incoming)
+        hit = None
+        with self._lock:
+            racecheck.note_access(self, "_entries")
+            bucket = self._entries.get(fp)
+            if bucket is not None:
+                self._entries.move_to_end(fp)
+                for cand in bucket:
+                    # exact verification — the fingerprint only routes
+                    if cand == incoming:
+                        hit = cand
+                        break
+            if hit is not None:
+                self.hits += 1
+            else:
+                if bucket is None:
+                    bucket = []
+                    self._entries[fp] = bucket
+                bucket.append(incoming)
+                while len(bucket) > self.MAX_PER_BUCKET:
+                    bucket.pop(0)
+                self.misses += 1
+                while len(self._entries) > self.MAX_ENTRIES:
+                    self._entries.popitem(last=False)
+        # metrics outside the intern lock (registry has its own)
+        self._count("hit" if hit is not None else "miss")
+        return hit if hit is not None else incoming
+
+    def _count(self, kind: str) -> None:
+        m = self.metrics
+        if m is not None:
+            from ..metrics import names as mnames
+
+            m.counter(
+                mnames.SERDE_INTERN_HITS
+                if kind == "hit"
+                else mnames.SERDE_INTERN_MISSES
+            )
+
+    def size(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._entries.values())
+
+
+names_interner = NodeNamesInterner()
+
+
+def intern_node_names(names: list) -> tuple:
+    return names_interner.intern(names)
+
+
+@guarded_by("_lock", "_cache")
+class UniformFailureEncoder:
+    """Reusable encoded-response buffers for uniform all-nodes failures.
+
+    A Filter failure answers ``{node: message for node in candidates}``
+    — at 10k candidates that is ~2-5 ms of json.dumps per response, for
+    bytes that are identical across every request sharing the (interned
+    candidate tuple, message) pair.  Entries pin the names tuple they
+    were built for and verify identity on hit, so an id() recycled
+    after eviction can never alias."""
+
+    MAX_ENTRIES = 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (id(names), message) → (names, encoded bytes)
+        self._cache: OrderedDict = OrderedDict()
+
+    def encode(self, names: tuple, message: str, error: str = "") -> bytes:
+        key = (id(names), message, error)
+        with self._lock:
+            racecheck.note_access(self, "_cache")
+            hit = self._cache.get(key)
+            if hit is not None and hit[0] is names:
+                self._cache.move_to_end(key)
+                return hit[1]
+        encoded = json.dumps(
+            {
+                "NodeNames": None,
+                "FailedNodes": {n: message for n in names} or None,
+                "Error": error or None,
+            }
+        ).encode()
+        with self._lock:
+            racecheck.note_access(self, "_cache")
+            self._cache[key] = (names, encoded)
+            while len(self._cache) > self.MAX_ENTRIES:
+                self._cache.popitem(last=False)
+        return encoded
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+
+uniform_failure_encoder = UniformFailureEncoder()
+
+
+def encode_extender_filter_result(result: ExtenderFilterResult) -> bytes:
+    """Encoded response body, served from the reusable buffer pool when
+    the result is a uniform all-nodes failure over an interned candidate
+    tuple (ExtenderFilterResult.uniform_failure, set by the extender's
+    failure paths); a fresh dumps otherwise."""
+    uniform = getattr(result, "uniform_failure", None)
+    if (
+        uniform is not None
+        and isinstance(uniform[0], tuple)
+        and len(result.failed_nodes) == len(uniform[0])
+        and not result.node_names
+    ):
+        names, message = uniform
+        return uniform_failure_encoder.encode(names, message, result.error)
+    return json.dumps(result.to_dict()).encode()
 
 
 # ---------------------------------------------------------------------------

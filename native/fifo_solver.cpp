@@ -24,7 +24,12 @@
 // C ABI via ctypes (k8s_spark_scheduler_tpu/native/fifo.py).
 
 #include <algorithm>
+#include <condition_variable>
 #include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -438,6 +443,321 @@ bool mf_assign(const std::vector<int32_t>& caps_by_node, int64_t k,
 }
 
 // ---------------------------------------------------------------------------
+// Sharded capacity pass — the cold-solve fallback of the delta-solve
+// session (ops/deltasolve.py).  The per-app capacity pass is the only
+// O(nodes) cost with no carry dependency, so it shards cleanly: each
+// worker runs the dim-at-a-time sweeps over a contiguous node range and
+// reports a partial total; the caller sums partials in shard order, so
+// results are BIT-identical to the serial pass (per-node caps are
+// independent, int64 partial sums are exact).  Dispatch is condvar
+// wake + condvar completion, never spinning: on an oversubscribed or
+// single-core host idle workers cost nothing.  The pool only engages
+// when the session was loaded with n_threads > 1 AND the node axis is
+// long enough that the ~10us dispatch round-trip amortizes (the
+// min_pool_nodes load parameter; at 10k nodes a pass is ~20us, at 100k
+// ~200us — the pool is for the latter).
+// ---------------------------------------------------------------------------
+
+constexpr int kMaxPoolThreads = 8;
+
+struct CapTask {
+  const int32_t* a0;
+  const int32_t* a1;
+  const int32_t* a2;
+  const uint8_t* elig;
+  const int32_t* e;
+  int32_t k;
+  int mode;  // 0 = clamped [0,k] (solve_queue); 1 = unclamped min-frag
+  int32_t* cap;
+  int64_t* totals;  // [shards] partial totals, summed in shard order
+  int64_t nb;
+  int shards;
+};
+
+void cap_task_shard(const CapTask& t, int shard) {
+  const int64_t lo = t.nb * shard / t.shards;
+  const int64_t hi = t.nb * (shard + 1) / t.shards;
+  if (hi <= lo) {
+    t.totals[shard] = 0;
+    return;
+  }
+  const int32_t init = t.mode == 0 ? t.k : kMfSent;
+  cap_sweeps(t.a0 + lo, t.a1 + lo, t.a2 + lo, hi - lo, t.e, init, t.cap + lo);
+  int64_t total = 0;
+  if (t.mode == 0) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int32_t c = t.elig[i] ? t.cap[i] : 0;
+      c = std::max(c, 0);
+      t.cap[i] = c;
+      total += c;
+    }
+  } else {
+    for (int64_t i = lo; i < hi; ++i) {
+      int32_t c = t.elig[i] ? t.cap[i] : 0;
+      t.cap[i] = c;
+      total += std::clamp<int32_t>(c, 0, t.k);
+    }
+  }
+  t.totals[shard] = total;
+}
+
+class SweepPool {
+ public:
+  explicit SweepPool(int workers) : n_(std::max(workers, 1)) {
+    for (int w = 1; w < n_; ++w) {
+      threads_.emplace_back([this, w] { worker(w); });
+    }
+  }
+
+  ~SweepPool() {
+    {
+      std::lock_guard<std::mutex> g(m_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  int workers() const { return n_; }
+
+  // Runs cap_task_shard for every shard; the caller thread takes shard 0
+  // and blocks until all workers report done.
+  void run(const CapTask& t) {
+    if (n_ <= 1) {
+      cap_task_shard(t, 0);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> g(m_);
+      task_ = &t;
+      ++gen_;
+      pending_ = n_ - 1;
+    }
+    cv_work_.notify_all();
+    cap_task_shard(t, 0);
+    std::unique_lock<std::mutex> g(m_);
+    cv_done_.wait(g, [this] { return pending_ == 0; });
+    task_ = nullptr;
+  }
+
+ private:
+  void worker(int w) {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> g(m_);
+    for (;;) {
+      cv_work_.wait(g, [&] { return stop_ || gen_ != seen; });
+      if (stop_) return;
+      seen = gen_;
+      const CapTask* t = task_;
+      g.unlock();
+      cap_task_shard(*t, w);
+      g.lock();
+      if (--pending_ == 0) cv_done_.notify_one();
+    }
+  }
+
+  const int n_;
+  std::vector<std::thread> threads_;
+  std::mutex m_;
+  std::condition_variable cv_work_, cv_done_;
+  const CapTask* task_ = nullptr;
+  uint64_t gen_ = 0;
+  int pending_ = 0;
+  bool stop_ = false;
+};
+
+// Serial when pool is null / single-worker, sharded otherwise; the two
+// produce identical caps and totals (see CapTask notes).
+int64_t cap_pass_sharded(SweepPool* pool, int mode, const int32_t* a0,
+                         const int32_t* a1, const int32_t* a2,
+                         const uint8_t* elig, int64_t nb, const int32_t* e,
+                         int32_t k, int32_t* cap) {
+  if (pool == nullptr || pool->workers() <= 1) {
+    return mode == 0 ? cap_pass_all(a0, a1, a2, elig, nb, e, k, cap)
+                     : mf_cap_pass_all(a0, a1, a2, elig, nb, e, k, cap);
+  }
+  int64_t totals[kMaxPoolThreads] = {0};
+  CapTask t{a0, a1, a2, elig, e,  k,
+            mode, cap, totals, nb, pool->workers()};
+  pool->run(t);
+  int64_t total = 0;
+  for (int s = 0; s < t.shards; ++s) total += totals[s];
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// Shared per-app queue step — ONE implementation of the FIFO step for
+// both the stateless entry points (fifo_solve_queue /
+// fifo_solve_queue_minfrag) and the persistent session below, so the
+// session's warm-resume decisions are bit-identical to a cold solve by
+// construction, not by parallel maintenance of two loops.
+// ---------------------------------------------------------------------------
+
+struct QueueScratch {
+  std::vector<int32_t> cap;      // clamped capacities (plain policies)
+  std::vector<int32_t> mf_caps;  // unclamped min-frag capacities
+  MfScratch mf_ws;
+  MfSegs segs;
+};
+
+std::vector<int32_t> build_cand(const int32_t* driver_rank, int64_t nb) {
+  std::vector<int32_t> cand;
+  cand.reserve(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    if (driver_rank[i] < kBig) cand.push_back(static_cast<int32_t>(i));
+  }
+  std::sort(cand.begin(), cand.end(), [&](int32_t x, int32_t y) {
+    return driver_rank[x] < driver_rank[y];
+  });
+  return cand;
+}
+
+// One tightly/evenly FIFO step: capacity pass + first-rank driver probe
+// + the usage-subtraction quirk.  Mutates the planes on success.
+// Returns the driver index or -1 (infeasible, planes untouched).
+int32_t step_app_plain(int32_t* a0, int32_t* a1, int32_t* a2,
+                       const uint8_t* exec_ok, int64_t nb,
+                       const std::vector<int32_t>& cand, const int32_t* d,
+                       const int32_t* e, int32_t k, int evenly,
+                       QueueScratch& ws, SweepPool* pool) {
+  int32_t* cap = ws.cap.data();
+  int64_t total =
+      cap_pass_sharded(pool, 0, a0, a1, a2, exec_ok, nb, e, k, cap);
+  int32_t didx = -1;
+  int32_t capd = 0;
+  if (total >= k) {
+    for (int32_t i : cand) {
+      int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+      if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+      int32_t am[kDims];
+      for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+      int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+      if (total - cap[i] + cwd >= k) {
+        didx = i;
+        capd = cwd;
+        break;
+      }
+    }
+  }
+  if (didx < 0) return -1;
+  auto sub_exec = [&](int64_t i) {
+    a0[i] = wrap_sub(a0[i], e[0]);
+    a1[i] = wrap_sub(a1[i], e[1]);
+    a2[i] = wrap_sub(a2[i], e[2]);
+  };
+  bool driver_hosts_exec = false;
+  if (evenly) {
+    // hosting nodes = first k capacity-bearing nodes in node order
+    int32_t placed = 0;
+    for (int64_t i = 0; i < nb && placed < k; ++i) {
+      int32_t c = (i == didx) ? capd : cap[i];
+      if (c <= 0) continue;
+      ++placed;
+      if (i == didx) driver_hosts_exec = true;
+      sub_exec(i);
+    }
+  } else {
+    // tightly-pack: greedy fill in node order until k executors sit
+    int64_t cum = 0;
+    for (int64_t i = 0; i < nb && cum < k; ++i) {
+      int32_t c = (i == didx) ? capd : cap[i];
+      if (c <= 0) continue;
+      cum += c;
+      if (i == didx) driver_hosts_exec = true;
+      sub_exec(i);
+    }
+  }
+  if (!driver_hosts_exec) {
+    a0[didx] = wrap_sub(a0[didx], d[0]);
+    a1[didx] = wrap_sub(a1[didx], d[1]);
+    a2[didx] = wrap_sub(a2[didx], d[2]);
+  }
+  return didx;
+}
+
+// One minimal-fragmentation FIFO step (fifo_solve_queue_minfrag body).
+int32_t step_app_minfrag(int32_t* a0, int32_t* a1, int32_t* a2,
+                         const uint8_t* exec_ok, int64_t nb,
+                         const std::vector<int32_t>& cand, const int32_t* d,
+                         const int32_t* e, int32_t k, QueueScratch& ws,
+                         SweepPool* pool) {
+  int32_t* caps = ws.mf_caps.data();
+  // ONE pass yields both the UNCLAMPED min-frag capacities and the
+  // tightly feasibility total sum(clamp(c, 0, k))
+  int64_t total =
+      cap_pass_sharded(pool, 1, a0, a1, a2, exec_ok, nb, e, k, caps);
+  int32_t didx = -1;
+  if (total >= k) {
+    for (int32_t i : cand) {
+      int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+      if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+      int32_t am[kDims];
+      for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+      int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+      if (total - std::clamp<int32_t>(caps[i], 0, k) + cwd >= k) {
+        didx = i;
+        break;
+      }
+    }
+  }
+  if (didx < 0) return -1;
+
+  // min-frag placement with the driver subtracted on its node — only
+  // the driver node's capacity differs from the fused pass
+  if (exec_ok[didx]) {
+    int32_t av[kDims];
+    av[0] = wrap_sub(a0[didx], d[0]);
+    av[1] = wrap_sub(a1[didx], d[1]);
+    av[2] = wrap_sub(a2[didx], d[2]);
+    caps[didx] = mf_cap_one(av[0], av[1], av[2], e);
+  }
+  bool placed_any =
+      k > 0 && mf_assign(ws.mf_caps, k,
+                         mf_extremes(ws.mf_caps, k, ws.mf_ws.copy), ws.mf_ws,
+                         ws.segs);
+
+  // usage subtraction quirk: one executor's worth per hosting node,
+  // the driver row on its node unless it also hosts executors
+  bool driver_hosts_exec = false;
+  if (placed_any) {
+    for (const auto& seg : ws.segs) {
+      const int32_t i = seg.first;
+      if (i == didx) driver_hosts_exec = true;
+      a0[i] = wrap_sub(a0[i], e[0]);
+      a1[i] = wrap_sub(a1[i], e[1]);
+      a2[i] = wrap_sub(a2[i], e[2]);
+    }
+  }
+  if (!driver_hosts_exec) {
+    a0[didx] = wrap_sub(a0[didx], d[0]);
+    a1[didx] = wrap_sub(a1[didx], d[1]);
+    a2[didx] = wrap_sub(a2[didx], d[2]);
+  }
+  return didx;
+}
+
+void split_planes(const int32_t* rows, int64_t nb, std::vector<int32_t>& a0,
+                  std::vector<int32_t>& a1, std::vector<int32_t>& a2) {
+  a0.resize(nb);
+  a1.resize(nb);
+  a2.resize(nb);
+  for (int64_t i = 0; i < nb; ++i) {
+    a0[i] = rows[i * kDims + 0];
+    a1[i] = rows[i * kDims + 1];
+    a2[i] = rows[i * kDims + 2];
+  }
+}
+
+void join_planes(const std::vector<int32_t>& a0, const std::vector<int32_t>& a1,
+                 const std::vector<int32_t>& a2, int64_t nb, int32_t* rows) {
+  for (int64_t i = 0; i < nb; ++i) {
+    rows[i * kDims + 0] = a0[i];
+    rows[i * kDims + 1] = a1[i];
+    rows[i * kDims + 2] = a2[i];
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Exact packing-efficiency math (efficiency.go:80-105 via
 // ops/fifo_solver.efficiencies_from_rows): float64 ops in the same IEEE
 // order as the numpy columns, so zone scores are bit-identical to the
@@ -496,24 +816,18 @@ int fifo_solve_queue(int64_t nb, int64_t na, int32_t* avail_io,
                      const int32_t* counts, const uint8_t* app_valid,
                      int evenly, uint8_t* out_feasible,
                      int32_t* out_driver_idx) {
-  // rank-sorted driver candidates, built once (ranks are unique)
-  std::vector<int32_t> cand;
-  cand.reserve(nb);
-  for (int64_t i = 0; i < nb; ++i) {
-    if (driver_rank[i] < kBig) cand.push_back(static_cast<int32_t>(i));
-  }
-  std::sort(cand.begin(), cand.end(), [&](int32_t x, int32_t y) {
-    return driver_rank[x] < driver_rank[y];
-  });
-
-  // availability as column planes for the SIMD capacity pass; written
-  // back to the row-major buffer at the end
-  std::vector<int32_t> a0(nb), a1(nb), a2(nb), cap(nb);
-  for (int64_t i = 0; i < nb; ++i) {
-    a0[i] = avail_io[i * kDims + 0];
-    a1[i] = avail_io[i * kDims + 1];
-    a2[i] = avail_io[i * kDims + 2];
-  }
+  // rank-sorted driver candidates, built once (ranks are unique);
+  // availability as column planes for the SIMD capacity pass, written
+  // back to the row-major buffer at the end.  The per-app step itself
+  // is shared with the persistent session (step_app_plain): capacity
+  // pass, first-rank driver probe whose total < k early-out is exact
+  // (for fitting nodes avail−driver stays in [0, avail], so capacity
+  // can only shrink), and the sparkpods.go:139-146 subtraction quirk.
+  std::vector<int32_t> cand = build_cand(driver_rank, nb);
+  std::vector<int32_t> a0, a1, a2;
+  split_planes(avail_io, nb, a0, a1, a2);
+  QueueScratch ws;
+  ws.cap.resize(nb);
 
   for (int64_t ai = 0; ai < na; ++ai) {
     const int32_t* d = drivers + ai * kDims;
@@ -522,79 +836,13 @@ int fifo_solve_queue(int64_t nb, int64_t na, int32_t* avail_io,
     out_feasible[ai] = 0;
     out_driver_idx[ai] = static_cast<int32_t>(nb);
     if (!app_valid[ai]) continue;
-
-    // pass 1: per-node capacity + total S (dim-at-a-time sweeps);
-    // divisors floor at 1 like the host's max(executor, 1)
-    int64_t total = cap_pass_all(a0.data(), a1.data(), a2.data(), exec_ok,
-                                 nb, e, k, cap.data());
-
-    // driver choice: first rank-ordered candidate that fits and leaves
-    // total capacity ≥ k with the driver subtracted from its node.
-    // (For fitting nodes avail−driver stays in [0, avail], so capacity
-    // can only shrink and total_d ≤ total — the total < k early-out is
-    // exact.)
-    int32_t didx = -1;
-    int32_t capd = 0;
-    if (total >= k) {
-      for (int32_t i : cand) {
-        int32_t a[kDims] = {a0[i], a1[i], a2[i]};
-        if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
-        int32_t am[kDims];
-        for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
-        int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
-        if (total - cap[i] + cwd >= k) {
-          didx = i;
-          capd = cwd;
-          break;
-        }
-      }
-    }
+    int32_t didx = step_app_plain(a0.data(), a1.data(), a2.data(), exec_ok,
+                                  nb, cand, d, e, k, evenly, ws, nullptr);
     if (didx < 0) continue;
-
     out_feasible[ai] = 1;
     out_driver_idx[ai] = didx;
-
-    // usage subtraction (sparkpods.go:139-146 quirk): ONE executor's
-    // worth per hosting node; the driver row on its node unless that
-    // node also hosts executors
-    auto sub_exec = [&](int64_t i) {
-      a0[i] = wrap_sub(a0[i], e[0]);
-      a1[i] = wrap_sub(a1[i], e[1]);
-      a2[i] = wrap_sub(a2[i], e[2]);
-    };
-    bool driver_hosts_exec = false;
-    if (evenly) {
-      // hosting nodes = first k capacity-bearing nodes in node order
-      int32_t placed = 0;
-      for (int64_t i = 0; i < nb && placed < k; ++i) {
-        int32_t c = (i == didx) ? capd : cap[i];
-        if (c <= 0) continue;
-        ++placed;
-        if (i == didx) driver_hosts_exec = true;
-        sub_exec(i);
-      }
-    } else {
-      // tightly-pack: greedy fill in node order until k executors sit
-      int64_t cum = 0;
-      for (int64_t i = 0; i < nb && cum < k; ++i) {
-        int32_t c = (i == didx) ? capd : cap[i];
-        if (c <= 0) continue;
-        cum += c;
-        if (i == didx) driver_hosts_exec = true;
-        sub_exec(i);
-      }
-    }
-    if (!driver_hosts_exec) {
-      a0[didx] = wrap_sub(a0[didx], d[0]);
-      a1[didx] = wrap_sub(a1[didx], d[1]);
-      a2[didx] = wrap_sub(a2[didx], d[2]);
-    }
   }
-  for (int64_t i = 0; i < nb; ++i) {
-    avail_io[i * kDims + 0] = a0[i];
-    avail_io[i * kDims + 1] = a1[i];
-    avail_io[i * kDims + 2] = a2[i];
-  }
+  join_planes(a0, a1, a2, nb, avail_io);
   return 1;
 }
 
@@ -610,24 +858,16 @@ int fifo_solve_queue_minfrag(int64_t nb, int64_t na, int32_t* avail_io,
                              const int32_t* executors, const int32_t* counts,
                              const uint8_t* app_valid, uint8_t* out_feasible,
                              int32_t* out_driver_idx) {
-  std::vector<int32_t> cand;
-  cand.reserve(nb);
-  for (int64_t i = 0; i < nb; ++i) {
-    if (driver_rank[i] < kBig) cand.push_back(static_cast<int32_t>(i));
-  }
-  std::sort(cand.begin(), cand.end(), [&](int32_t x, int32_t y) {
-    return driver_rank[x] < driver_rank[y];
-  });
-
-  std::vector<int32_t> a0(nb), a1(nb), a2(nb);
-  for (int64_t i = 0; i < nb; ++i) {
-    a0[i] = avail_io[i * kDims + 0];
-    a1[i] = avail_io[i * kDims + 1];
-    a2[i] = avail_io[i * kDims + 2];
-  }
-  std::vector<int32_t> mf_caps(nb);
-  MfScratch mf_ws;
-  MfSegs segs;
+  // per-app step shared with the persistent session (step_app_minfrag):
+  // one fused pass yields both the UNCLAMPED min-frag capacities and
+  // the tightly feasibility total, the driver-node capacity is fixed up
+  // after the choice (batch_solver.min_frag_step_counts), and the
+  // carried subtraction comes from the drain segments.
+  std::vector<int32_t> cand = build_cand(driver_rank, nb);
+  std::vector<int32_t> a0, a1, a2;
+  split_planes(avail_io, nb, a0, a1, a2);
+  QueueScratch ws;
+  ws.mf_caps.resize(nb);
 
   for (int64_t ai = 0; ai < na; ++ai) {
     const int32_t* d = drivers + ai * kDims;
@@ -636,65 +876,13 @@ int fifo_solve_queue_minfrag(int64_t nb, int64_t na, int32_t* avail_io,
     out_feasible[ai] = 0;
     out_driver_idx[ai] = static_cast<int32_t>(nb);
     if (!app_valid[ai]) continue;
-
-    // ONE fused pass yields both the UNCLAMPED min-frag capacities and
-    // the tightly feasibility total Σ clamp(c, 0, k)
-    int64_t total = mf_cap_pass_all(a0.data(), a1.data(), a2.data(),
-                                    exec_ok, nb, e, k, mf_caps.data());
-    int32_t didx = -1;
-    if (total >= k) {
-      for (int32_t i : cand) {
-        int32_t a[kDims] = {a0[i], a1[i], a2[i]};
-        if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
-        int32_t am[kDims];
-        for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
-        int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
-        if (total - std::clamp<int32_t>(mf_caps[i], 0, k) + cwd >= k) {
-          didx = i;
-          break;
-        }
-      }
-    }
+    int32_t didx = step_app_minfrag(a0.data(), a1.data(), a2.data(), exec_ok,
+                                    nb, cand, d, e, k, ws, nullptr);
     if (didx < 0) continue;
     out_feasible[ai] = 1;
     out_driver_idx[ai] = didx;
-
-    // min-frag placement with the driver subtracted on its node
-    // (batch_solver.min_frag_step_counts) — only the driver node's
-    // capacity differs from the fused pass
-    if (exec_ok[didx]) {
-      int32_t av[kDims];
-      for (int j = 0; j < kDims; ++j)
-        av[j] = wrap_sub((j == 0 ? a0 : j == 1 ? a1 : a2)[didx], d[j]);
-      mf_caps[didx] = mf_cap_one(av[0], av[1], av[2], e);
-    }
-    bool placed_any =
-        k > 0 && mf_assign(mf_caps, k, mf_extremes(mf_caps, k, mf_ws.copy),
-                           mf_ws, segs);
-
-    // usage subtraction quirk: one executor's worth per hosting node,
-    // the driver row on its node unless it also hosts executors
-    bool driver_hosts_exec = false;
-    if (placed_any) {
-      for (const auto& seg : segs) {
-        const int32_t i = seg.first;
-        if (i == didx) driver_hosts_exec = true;
-        a0[i] = wrap_sub(a0[i], e[0]);
-        a1[i] = wrap_sub(a1[i], e[1]);
-        a2[i] = wrap_sub(a2[i], e[2]);
-      }
-    }
-    if (!driver_hosts_exec) {
-      a0[didx] = wrap_sub(a0[didx], d[0]);
-      a1[didx] = wrap_sub(a1[didx], d[1]);
-      a2[didx] = wrap_sub(a2[didx], d[2]);
-    }
   }
-  for (int64_t i = 0; i < nb; ++i) {
-    avail_io[i * kDims + 0] = a0[i];
-    avail_io[i * kDims + 1] = a1[i];
-    avail_io[i * kDims + 2] = a2[i];
-  }
+  join_planes(a0, a1, a2, nb, avail_io);
   return 1;
 }
 
@@ -1007,13 +1195,285 @@ int fifo_solve_app(int64_t nb, const int32_t* avail,
   return 1;
 }
 
+// ---------------------------------------------------------------------------
+// Persistent solver session (ops/deltasolve.py) — the warm path of the
+// incremental delta-solve engine.
+//
+// A session pins one (cluster basis, policy) problem in native memory:
+// the scaled availability planes at queue position 0, the rank-sorted
+// driver-candidate list (sorted ONCE per basis instead of once per
+// request), the queue rows it last solved with their per-position
+// verdicts, and prefix checkpoints of the carried availability every
+// `stride` positions plus the final tail.  A warm solve self-verifies
+// the queue prefix byte-for-byte against the cached rows (the Python
+// caller's id-based bookkeeping is an optimization, never a correctness
+// input), restores the nearest checkpoint at or below the first changed
+// position, and re-runs only the suffix — O(changed suffix × nodes)
+// instead of O(queue × nodes).
+//
+// Checkpoint memory is bounded: at most kMaxCheckpoints live at once;
+// when the queue grows past stride × kMaxCheckpoints the stride doubles
+// and odd checkpoints are dropped (positions at even multiples of the
+// old stride are exactly the multiples of the new one), so resume
+// granularity degrades gracefully instead of memory growing with the
+// queue.
+// ---------------------------------------------------------------------------
+
+namespace {
+constexpr int64_t kMaxCheckpoints = 24;
+}
+
+struct FifoSession {
+  int64_t nb = 0;
+  int policy = 0;  // 0 tightly-pack, 1 distribute-evenly, 2 min-frag
+  int64_t stride = 64;
+  std::vector<int32_t> basis0, basis1, basis2;  // planes at position 0
+  std::vector<uint8_t> eok;
+  std::vector<int32_t> cand;  // rank-sorted driver candidates
+  // last-solved queue: packed rows [na][8] = d0 d1 d2 e0 e1 e2 count
+  // valid, plus the per-position verdicts
+  std::vector<int32_t> apps;
+  std::vector<uint8_t> feas;
+  std::vector<int32_t> didx;
+  int64_t na = 0;
+  // chk*[j] = planes BEFORE the app at position (j+1)*stride
+  std::vector<std::vector<int32_t>> chk0, chk1, chk2;
+  // planes after all `na` cached apps (the "checkpoint at position na")
+  std::vector<int32_t> tail0, tail1, tail2;
+  std::vector<int32_t> a0, a1, a2;  // working planes
+  QueueScratch ws;
+  SweepPool* pool = nullptr;
+  ~FifoSession() { delete pool; }
+};
+
+extern "C" void* fifo_sess_create() {
+  return new (std::nothrow) FifoSession();
+}
+
+extern "C" void fifo_sess_destroy(void* handle) {
+  delete static_cast<FifoSession*>(handle);
+}
+
+// (Re)load the session basis: scaled availability rows [nb,3] at queue
+// position 0, driver ranks, executor eligibility, policy, checkpoint
+// stride, worker count for the sharded cold pass (engages only when
+// n_threads > 1 and nb >= min_pool_nodes).  Drops all cached queue
+// state.  Returns 1 on success.
+extern "C" int fifo_sess_load(void* handle, int64_t nb,
+                              const int32_t* avail_rows,
+                              const int32_t* driver_rank,
+                              const uint8_t* exec_ok, int policy,
+                              int64_t stride, int n_threads,
+                              int64_t min_pool_nodes) {
+  FifoSession* s = static_cast<FifoSession*>(handle);
+  if (s == nullptr || nb <= 0 || stride <= 0) return 0;
+  s->nb = nb;
+  s->policy = policy;
+  s->stride = stride;
+  split_planes(avail_rows, nb, s->basis0, s->basis1, s->basis2);
+  s->eok.assign(exec_ok, exec_ok + nb);
+  s->cand = build_cand(driver_rank, nb);
+  s->apps.clear();
+  s->feas.clear();
+  s->didx.clear();
+  s->na = 0;
+  s->chk0.clear();
+  s->chk1.clear();
+  s->chk2.clear();
+  s->tail0 = s->basis0;
+  s->tail1 = s->basis1;
+  s->tail2 = s->basis2;
+  s->a0.resize(nb);
+  s->a1.resize(nb);
+  s->a2.resize(nb);
+  s->ws.cap.resize(nb);
+  s->ws.mf_caps.resize(nb);
+  int want = std::min(n_threads, kMaxPoolThreads);
+  if (want <= 1 || nb < min_pool_nodes) {
+    delete s->pool;
+    s->pool = nullptr;
+  } else if (s->pool == nullptr || s->pool->workers() != want) {
+    delete s->pool;
+    s->pool = new (std::nothrow) SweepPool(want);
+  }
+  return 1;
+}
+
+// Solve the queue `apps8` ([na][8] packed rows, same scaled units as
+// the loaded basis) against the session basis, resuming from the
+// nearest prefix checkpoint.  Writes per-position verdicts and the
+// post-queue availability rows.  Returns the resume position (0 = full
+// cold solve, na = everything served from cache), or -2 when the
+// session has no basis.
+extern "C" int64_t fifo_sess_solve(void* handle, int64_t na,
+                                   const int32_t* apps8, uint8_t* out_feas,
+                                   int32_t* out_didx,
+                                   int32_t* out_avail_rows) {
+  FifoSession* s = static_cast<FifoSession*>(handle);
+  if (s == nullptr || s->nb == 0 || na < 0) return -2;
+  const int64_t nb = s->nb;
+
+  // 1. first position whose packed row differs from the cached run —
+  // blocked memcmp then a row scan, so the common all-equal prefix
+  // costs one pass of memcmp bandwidth (~us at 1k apps)
+  const int64_t lim = std::min(na, s->na);
+  int64_t diff = lim;
+  {
+    const int32_t* cached = s->apps.data();
+    constexpr int64_t B = 256;
+    int64_t i = 0;
+    while (i < lim) {
+      const int64_t hi = std::min(lim, i + B);
+      if (std::memcmp(apps8 + i * 8, cached + i * 8,
+                      static_cast<size_t>(hi - i) * 8 * sizeof(int32_t)) ==
+          0) {
+        i = hi;
+        continue;
+      }
+      while (i < hi && std::memcmp(apps8 + i * 8, cached + i * 8,
+                                   8 * sizeof(int32_t)) == 0) {
+        ++i;
+      }
+      break;
+    }
+    diff = i;
+  }
+
+  // 2. stride doubling keeps the checkpoint set bounded as na grows
+  while (na / s->stride > kMaxCheckpoints) {
+    const int64_t keep = static_cast<int64_t>(s->chk0.size()) / 2;
+    for (int64_t j = 0; j < keep; ++j) {
+      // old index 2j+1 holds position (2j+2)·stride = (j+1)·(2·stride)
+      s->chk0[j] = std::move(s->chk0[2 * j + 1]);
+      s->chk1[j] = std::move(s->chk1[2 * j + 1]);
+      s->chk2[j] = std::move(s->chk2[2 * j + 1]);
+    }
+    s->chk0.resize(keep);
+    s->chk1.resize(keep);
+    s->chk2.resize(keep);
+    s->stride *= 2;
+  }
+
+  // 3. resume position: the largest checkpointed position ≤ diff (the
+  // tail counts as the checkpoint at position s->na)
+  int64_t r;
+  if (diff >= s->na) {
+    r = s->na;
+  } else {
+    int64_t j = diff / s->stride;
+    if (j > static_cast<int64_t>(s->chk0.size())) {
+      j = static_cast<int64_t>(s->chk0.size());
+    }
+    r = j * s->stride;
+  }
+
+  // 4. restore working planes from that checkpoint
+  if (r == s->na) {
+    s->a0 = s->tail0;
+    s->a1 = s->tail1;
+    s->a2 = s->tail2;
+  } else if (r == 0) {
+    s->a0 = s->basis0;
+    s->a1 = s->basis1;
+    s->a2 = s->basis2;
+  } else {
+    const int64_t j = r / s->stride - 1;
+    s->a0 = s->chk0[j];
+    s->a1 = s->chk1[j];
+    s->a2 = s->chk2[j];
+  }
+
+  // 5. checkpoints past the resume point describe a superseded suffix
+  const int64_t keep_chk = r / s->stride;
+  if (static_cast<int64_t>(s->chk0.size()) > keep_chk) {
+    s->chk0.resize(keep_chk);
+    s->chk1.resize(keep_chk);
+    s->chk2.resize(keep_chk);
+  }
+
+  // 6. adopt the new queue rows + verdict storage (prefix verdicts for
+  // [0, r) stay valid by construction)
+  s->apps.assign(apps8, apps8 + na * 8);
+  s->feas.resize(na);
+  s->didx.resize(na);
+
+  // 7. solve the suffix, dropping fresh checkpoints as positions pass
+  int32_t* a0 = s->a0.data();
+  int32_t* a1 = s->a1.data();
+  int32_t* a2 = s->a2.data();
+  const uint8_t* eok = s->eok.data();
+  for (int64_t i = r; i < na; ++i) {
+    if (i > 0 && i % s->stride == 0 &&
+        static_cast<int64_t>(s->chk0.size()) == i / s->stride - 1) {
+      s->chk0.push_back(s->a0);
+      s->chk1.push_back(s->a1);
+      s->chk2.push_back(s->a2);
+    }
+    const int32_t* row = s->apps.data() + i * 8;
+    const int32_t* d = row;
+    const int32_t* e = row + 3;
+    const int32_t k = row[6];
+    s->feas[i] = 0;
+    s->didx[i] = static_cast<int32_t>(nb);
+    if (!row[7]) continue;
+    int32_t di;
+    if (s->policy == 2) {
+      di = step_app_minfrag(a0, a1, a2, eok, nb, s->cand, d, e, k, s->ws,
+                            s->pool);
+    } else {
+      di = step_app_plain(a0, a1, a2, eok, nb, s->cand, d, e, k,
+                          s->policy == 1, s->ws, s->pool);
+    }
+    if (di >= 0) {
+      s->feas[i] = 1;
+      s->didx[i] = di;
+    }
+  }
+
+  // 8. tail + outputs
+  s->tail0 = s->a0;
+  s->tail1 = s->a1;
+  s->tail2 = s->a2;
+  s->na = na;
+  if (na > 0) {
+    std::memcpy(out_feas, s->feas.data(), static_cast<size_t>(na));
+    std::memcpy(out_didx, s->didx.data(),
+                static_cast<size_t>(na) * sizeof(int32_t));
+  }
+  join_planes(s->a0, s->a1, s->a2, nb, out_avail_rows);
+  return r;
+}
+
+// Resident bytes of the session's buffers (basis + checkpoints + tail +
+// working planes + queue cache) — the soak's bounded-memory assertion
+// reads this through the engine.
+extern "C" int64_t fifo_sess_mem_bytes(void* handle) {
+  FifoSession* s = static_cast<FifoSession*>(handle);
+  if (s == nullptr) return 0;
+  auto vb = [](const std::vector<int32_t>& v) {
+    return static_cast<int64_t>(v.capacity()) * sizeof(int32_t);
+  };
+  int64_t total = vb(s->basis0) + vb(s->basis1) + vb(s->basis2) +
+                  vb(s->tail0) + vb(s->tail1) + vb(s->tail2) + vb(s->a0) +
+                  vb(s->a1) + vb(s->a2) + vb(s->cand) + vb(s->apps) +
+                  vb(s->didx) + vb(s->ws.cap) + vb(s->ws.mf_caps) +
+                  static_cast<int64_t>(s->eok.capacity()) +
+                  static_cast<int64_t>(s->feas.capacity());
+  for (const auto& c : s->chk0) total += vb(c);
+  for (const auto& c : s->chk1) total += vb(c);
+  for (const auto& c : s->chk2) total += vb(c);
+  return total;
+}
+
 // CPython-compatible float64 sum: the packing-efficiency gauge
-// contract is bit-equality with the host lane's builtin sum(), which
-// since Python 3.12 is NEUMAIER-compensated summation, not naive
-// left-to-right (and not numpy's pairwise reduction either).  This is
-// the same algorithm CPython's float fast path runs, in the same
-// order, at C speed (~0.6ms of per-request PyFloat summing removed).
-// The optimize attribute pins scalar in-order codegen.
+// contract is bit-equality with the host lane's builtin sum().  Which
+// algorithm that is depends on the interpreter: since Python 3.12 the
+// float fast path is NEUMAIER-compensated summation; before that it is
+// naive left-to-right addition.  Both are provided and the ctypes
+// wrapper (native/fifo.py seq_sum_f64_native) picks by interpreter
+// version, so the bit-equality contract holds on either.  The optimize
+// attribute pins scalar in-order codegen (vectorizing would
+// reassociate).
 __attribute__((optimize("no-tree-vectorize", "no-unroll-loops")))
 double seq_sum_f64(const double* v, int64_t n) {
   double s = 0.0, c = 0.0;
@@ -1028,6 +1488,14 @@ double seq_sum_f64(const double* v, int64_t n) {
     s = t;
   }
   return s + c;
+}
+
+// pre-3.12 builtin sum(): plain sequential IEEE addition
+__attribute__((optimize("no-tree-vectorize", "no-unroll-loops")))
+double seq_sum_f64_plain(const double* v, int64_t n) {
+  double s = 0.0;
+  for (int64_t i = 0; i < n; ++i) s += v[i];
+  return s;
 }
 
 }  // extern "C"

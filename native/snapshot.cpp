@@ -127,6 +127,34 @@ int snap_scale_int32(void* handle, const int64_t* demand_rows, int64_t n_demands
 
 extern "C" {
 
+// First differing row index between two row-major [n, 3] int64 buffers,
+// or -1 when equal: the delta-solve engine's exact warm-basis check
+// (ops/deltasolve.py) — one memcmp-bandwidth pass instead of a numpy
+// elementwise compare + reduction, and the diff index comes for free
+// for diagnostics.  Blocked so the common all-equal case never drops to
+// the per-row scan.
+int64_t snap_rows_diff(const int64_t* a, const int64_t* b, int64_t n) {
+  constexpr int64_t kBlock = 512;
+  int64_t i = 0;
+  while (i < n) {
+    const int64_t hi = i + kBlock < n ? i + kBlock : n;
+    if (std::memcmp(a + i * kDims, b + i * kDims,
+                    static_cast<size_t>(hi - i) * kDims * sizeof(int64_t)) ==
+        0) {
+      i = hi;
+      continue;
+    }
+    for (; i < hi; ++i) {
+      if (a[i * kDims] != b[i * kDims] ||
+          a[i * kDims + 1] != b[i * kDims + 1] ||
+          a[i * kDims + 2] != b[i * kDims + 2]) {
+        return i;
+      }
+    }
+  }
+  return -1;
+}
+
 // Stateless one-shot scaling (no handle): the per-request marshal path.
 // Same contract as snap_scale_int32 but reads availability directly from
 // the caller's buffer (row-major [n, 3] int64).

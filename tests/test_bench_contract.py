@@ -68,6 +68,24 @@ def test_bench_final_line_is_the_headline(tmp_path):
         assert headline["samples"] == lane["rounds"] >= 2
         assert headline["backend"] == lane["backend"]
         assert "solver_p99_ms" in headline
+        # delta-solve annotations (PR 5): when the native session lane
+        # exists, the headline must carry the steady-state warm-hit rate
+        # and resume depth from the e2e phase plus the session lane's
+        # warm/cold solver p50s — dashboards and the acceptance bound
+        # (warm p50 ≥ 3x below cold p50) key on these exact names
+        from k8s_spark_scheduler_tpu.native.fifo import (
+            native_session_available,
+        )
+
+        if native_session_available():
+            assert 0.0 <= headline["warm_hit_rate"] <= 1.0
+            assert headline["warm_hit_rate"] == lane["warm_hit_rate"]
+            assert "resume_depth_p50" in headline
+            ds = artifact["lanes"].get("deltasolve-session cpu")
+            assert ds is not None
+            assert headline["warm_solve_p50_ms"] == ds["warm_p50_ms"] > 0
+            assert headline["cold_solve_p50_ms"] == ds["cold_p50_ms"] > 0
+            assert ds["warm_speedup_p50"] > 0
     else:
         assert headline["metric"].startswith("p99_queue_solve")
         assert lane is None

@@ -1,0 +1,550 @@
+"""Delta-solve engine contracts (ops/deltasolve.py + the native
+session in native/fifo_solver.cpp).
+
+The load-bearing property: **incremental decisions are byte-identical
+to cold full solves** — over random delta streams (availability
+bind/release churn, queue push/pop/mutation), across every queue
+policy, with the sharded cold pass on and off, and across the
+invalidation boundaries (structure churn, failover rebuild,
+recover_from_journal replay)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from k8s_spark_scheduler_tpu.native.fifo import (
+    POLICY_EVENLY,
+    POLICY_MINFRAG,
+    POLICY_TIGHTLY,
+    NativeFifoSession,
+    native_session_available,
+    solve_queue_min_frag_native,
+    solve_queue_native,
+)
+from k8s_spark_scheduler_tpu.state.store import (
+    DELTA_NODE_STRUCTURE,
+    DELTA_RESERVATION,
+    ChangeFeed,
+)
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+needs_native = pytest.mark.skipif(
+    not native_session_available(), reason="native session unavailable"
+)
+
+
+def _packed(drv, exe, cnt, val):
+    return np.hstack(
+        [drv, exe, cnt[:, None], val.astype(np.int32)[:, None]]
+    ).astype(np.int32)
+
+
+def _cold(policy, avail, rank, eok, drv, exe, cnt, val):
+    if policy == POLICY_MINFRAG:
+        return solve_queue_min_frag_native(avail, rank, eok, drv, exe, cnt, val)
+    return solve_queue_native(
+        avail, rank, eok, drv, exe, cnt, val, evenly=policy == POLICY_EVENLY
+    )
+
+
+# -- session-level property: random delta streams ----------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("policy", [POLICY_TIGHTLY, POLICY_EVENLY, POLICY_MINFRAG])
+@pytest.mark.parametrize("pool", [False, True])
+@pytest.mark.parametrize("seed", [11, 12, 13, 14, 15])
+def test_session_random_delta_stream_matches_cold_solves(policy, pool, seed):
+    """Replay a random stream of queue/availability deltas through ONE
+    persistent session; after every step the session's warm/resumed
+    answer must be byte-identical to a fresh stateless cold solve of the
+    same problem (feasible, driver_idx, avail_after).  `pool=True`
+    forces the sharded cold pass (2 workers, no node floor) so the
+    thread-pool path proves the same bits."""
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(40, 200))
+    avail = rng.randint(0, 200, size=(n, 3)).astype(np.int32)
+    rank = np.arange(n, dtype=np.int32)
+    rng.shuffle(rank)
+    eok = rng.rand(n) > 0.1
+
+    a0 = int(rng.randint(5, 40))
+    drv = rng.randint(0, 3, size=(a0, 3)).astype(np.int32)
+    exe = rng.randint(1, 5, size=(a0, 3)).astype(np.int32)
+    cnt = rng.randint(1, 8, size=a0).astype(np.int32)
+    val = np.ones(a0, dtype=bool)
+
+    sess = NativeFifoSession(
+        threads=2 if pool else 0, min_pool_nodes=0 if pool else 8192
+    )
+    sess.load(avail, rank, eok, policy, stride=8)
+    resumes = []
+    try:
+        for _ in range(12):
+            op = rng.randint(0, 5)
+            if op == 0 and len(cnt) > 1:  # pop front (scheduled head)
+                drv, exe, cnt, val = drv[1:], exe[1:], cnt[1:], val[1:]
+            elif op == 1:  # append arrivals
+                k = int(rng.randint(1, 4))
+                drv = np.vstack([drv, rng.randint(0, 3, size=(k, 3))]).astype(np.int32)
+                exe = np.vstack([exe, rng.randint(1, 5, size=(k, 3))]).astype(np.int32)
+                cnt = np.concatenate([cnt, rng.randint(1, 8, size=k)]).astype(np.int32)
+                val = np.concatenate([val, np.ones(k, bool)])
+            elif op == 2 and len(cnt) > 0:  # mutate a mid-queue app
+                i = int(rng.randint(0, len(cnt)))
+                exe[i] = rng.randint(1, 5, size=3)
+            elif op == 3:  # availability churn: the session must reload
+                delta = rng.randint(-20, 21, size=(n, 3)).astype(np.int32)
+                avail = np.maximum(avail + delta, 0).astype(np.int32)
+                sess.load(avail, rank, eok, policy, stride=8)
+            # op == 4: no change at all (pure retry)
+
+            r, feas, didx, after = sess.solve(_packed(drv, exe, cnt, val))
+            resumes.append(r)
+            ref_f, ref_d, ref_a = _cold(
+                policy, avail, rank, eok, drv, exe, cnt, val
+            )
+            np.testing.assert_array_equal(feas, ref_f)
+            np.testing.assert_array_equal(didx, ref_d)
+            np.testing.assert_array_equal(after, ref_a)
+        # a pure retry must always resume past the whole cached queue
+        r, feas, didx, after = sess.solve(_packed(drv, exe, cnt, val))
+        assert r == len(cnt)
+        ref_f, ref_d, ref_a = _cold(policy, avail, rank, eok, drv, exe, cnt, val)
+        np.testing.assert_array_equal(feas, ref_f)
+        np.testing.assert_array_equal(after, ref_a)
+    finally:
+        sess.close()
+
+
+@needs_native
+def test_session_stride_doubling_stays_exact_and_bounded():
+    rng = np.random.RandomState(7)
+    n = 64
+    avail = rng.randint(0, 100, size=(n, 3)).astype(np.int32)
+    rank = np.arange(n, dtype=np.int32)
+    eok = np.ones(n, dtype=bool)
+    a = 900  # 900 apps at stride 4 forces repeated checkpoint compaction
+    drv = rng.randint(0, 2, size=(a, 3)).astype(np.int32)
+    exe = rng.randint(1, 4, size=(a, 3)).astype(np.int32)
+    cnt = rng.randint(1, 4, size=a).astype(np.int32)
+    val = np.ones(a, dtype=bool)
+    sess = NativeFifoSession()
+    try:
+        sess.load(avail, rank, eok, POLICY_TIGHTLY, stride=4)
+        sess.solve(_packed(drv, exe, cnt, val))
+        bytes_at_900 = sess.mem_bytes()
+        drv2 = drv.copy()
+        drv2[500] += 1
+        r, feas, didx, after = sess.solve(_packed(drv2, exe, cnt, val))
+        assert 0 < r <= 500
+        ref = _cold(POLICY_TIGHTLY, avail, rank, eok, drv2, exe, cnt, val)
+        np.testing.assert_array_equal(feas, ref[0])
+        np.testing.assert_array_equal(after, ref[2])
+        # ≤ 24 checkpoints + basis + tail + working + queue cache
+        assert bytes_at_900 <= 30 * n * 12 + a * 8 * 4 + 2**16
+    finally:
+        sess.close()
+
+
+# -- change feed --------------------------------------------------------------
+
+
+def test_change_feed_sequence_and_kinds():
+    feed = ChangeFeed(capacity=8)
+    assert feed.seq == 0
+    s1 = feed.publish(DELTA_RESERVATION, "r1")
+    s2 = feed.publish(DELTA_NODE_STRUCTURE, "n1")
+    assert (s1, s2) == (1, 2)
+    assert feed.kinds_since(0) == {DELTA_RESERVATION, DELTA_NODE_STRUCTURE}
+    assert feed.kinds_since(1) == {DELTA_NODE_STRUCTURE}
+    assert feed.kinds_since(2) == frozenset()
+    for i in range(20):  # overflow the ring
+        feed.publish(DELTA_RESERVATION, f"x{i}")
+    assert feed.kinds_since(1) is None  # fell off: treat as everything
+    assert feed.kinds_since(feed.seq) == frozenset()
+
+
+def test_snapshot_content_key_tracks_mutations():
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        h.new_node("n1")
+        k0 = h.server.tensor_snapshot.snapshot().content_key
+        assert h.server.tensor_snapshot.snapshot().content_key == k0
+        h.new_node("n2")
+        k1 = h.server.tensor_snapshot.snapshot().content_key
+        assert k1 != k0 and k1[0] == k0[0] and k1[1] > k0[1]
+    finally:
+        h.close()
+
+
+# -- engine-level: warm hits, invalidation, decision parity -------------------
+
+
+def _cluster(h, n=8):
+    names = []
+    for i in range(n):
+        nm = f"n{i:02d}"
+        h.new_node(nm, cpu="16", memory="32Gi")
+        names.append(nm)
+    return names
+
+
+def _queue(h, count, t0):
+    for i in range(count):
+        h.create_pod(
+            h.static_allocation_spark_pods(
+                f"q-{i:03d}", 2, creation_timestamp=t0 - 1000 + i
+            )[0]
+        )
+
+
+@needs_native
+def test_engine_warm_hits_on_unchanged_state_and_depth_recorded():
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        names = _cluster(h)
+        t0 = time.time()
+        _queue(h, 12, t0)
+        big = h.static_allocation_spark_pods("big", 500, creation_timestamp=t0)[0]
+        h.create_pod(big)
+        for _ in range(3):  # failures create demands, never reservations
+            r = h.schedule(big, names)
+            assert not r.node_names
+        s = h.extender.delta_engine.stats()
+        assert s["cold_solves"] == 1
+        assert s["warm_hits"] == 2
+        assert s["resume_depth_p50"] == 12.0  # whole queue served from cache
+        assert s["sessions"] == 1
+    finally:
+        h.close()
+
+
+@needs_native
+def test_engine_memcmp_rescue_after_cancelling_churn():
+    """A reservation created then released bumps the change feed but
+    restores the exact availability basis — the content compare must
+    rescue the warm path (the bench's delete-after-sample steady
+    state)."""
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        names = _cluster(h)
+        t0 = time.time()
+        _queue(h, 10, t0)
+        rr = h.server.resource_reservation_cache
+        for i in range(3):
+            p = h.static_allocation_spark_pods(
+                f"probe-{i}", 2, creation_timestamp=t0 + i
+            )[0]
+            h.create_pod(p)
+            assert h.schedule(p, names).node_names
+            h.api.delete("Pod", "default", p.name)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if rr.get("default", p.labels.get("spark-app-id", "")) is None:
+                    break
+                time.sleep(0.005)
+        s = h.extender.delta_engine.stats()
+        assert s["cold_solves"] == 1 and s["warm_hits"] == 2
+    finally:
+        h.close()
+
+
+@needs_native
+def test_engine_structure_churn_misses_session_but_decisions_match():
+    """Cordoning a node changes the structure revision: the session key
+    misses (cold rebuild), and decisions equal an engine-less run of the
+    identical script."""
+
+    def script(h, names):
+        out = []
+        t0 = time.time()
+        _queue(h, 8, t0)
+        p1 = h.static_allocation_spark_pods("s-a", 2, creation_timestamp=t0)[0]
+        h.create_pod(p1)
+        out.append(tuple(h.schedule(p1, names).node_names or ()))
+        node = h.api.get("Node", "default", names[0])
+        node.unschedulable = True
+        h.api.update(node)
+        p2 = h.static_allocation_spark_pods("s-b", 2, creation_timestamp=t0 + 1)[0]
+        h.create_pod(p2)
+        out.append(tuple(h.schedule(p2, names).node_names or ()))
+        node = h.api.get("Node", "default", names[0])
+        node.unschedulable = False
+        h.api.update(node)
+        p3 = h.static_allocation_spark_pods("s-c", 2, creation_timestamp=t0 + 2)[0]
+        h.create_pod(p3)
+        out.append(tuple(h.schedule(p3, names).node_names or ()))
+        return out
+
+    h1 = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        decisions_on = script(h1, _cluster(h1))
+        stats = h1.extender.delta_engine.stats()
+    finally:
+        h1.close()
+    from k8s_spark_scheduler_tpu.config import Install
+
+    h2 = Harness(
+        extra_install=Install(
+            fifo=True, binpack_algo="tpu-batch", delta_solve=False
+        )
+    )
+    try:
+        assert h2.extender.delta_engine is None
+        decisions_off = script(h2, _cluster(h2))
+    finally:
+        h2.close()
+    assert decisions_on == decisions_off
+    assert all(d for d in decisions_on)
+    # every cordon/uncordon forced a fresh session build
+    assert stats["cold_solves"] >= 3
+
+
+@needs_native
+def test_engine_invalidates_across_failover_and_journal_replay(tmp_path):
+    """A new instance (failover) starts with an empty session map and
+    serves decisions identical to an engine-less reference; journaled
+    intents replayed through recover_from_journal flow into the tensor
+    mirror and invalidate by content (the feed sequence moves)."""
+    from k8s_spark_scheduler_tpu.config import Install
+    from k8s_spark_scheduler_tpu.server.wiring import init_server_with_clients
+
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        names = _cluster(h, n=4)
+        t0 = time.time()
+        _queue(h, 6, t0)
+        p = h.static_allocation_spark_pods("pre", 2, creation_timestamp=t0)[0]
+        h.create_pod(p)
+        assert h.schedule(p, names).node_names
+        assert h.extender.delta_engine.stats()["sessions"] == 1
+        h.server.stop()
+
+        new_server = init_server_with_clients(
+            h.api,
+            Install(fifo=True, binpack_algo="tpu-batch"),
+            demand_poll_interval=0.02,
+        )
+        try:
+            engine = new_server.extender.delta_engine
+            assert engine is not None and engine.stats()["sessions"] == 0
+            probe = Harness.static_allocation_spark_pods(
+                "post", 2, creation_timestamp=t0 + 5
+            )[0]
+            h.api.create(probe)
+            r = new_server.extender.predicate(
+                ExtenderArgs(pod=probe, node_names=names)
+            )
+            assert r.node_names
+            assert engine.stats()["cold_solves"] >= 1
+
+            # a replayed/external reservation write invalidates by
+            # content: the next decision cold-solves against it
+            feed_before = new_server.tensor_snapshot.snapshot().content_key
+            victim = new_server.resource_reservation_cache.get("default", "pre")
+            assert victim is not None
+            new_server.resource_reservation_cache.delete("default", "pre")
+            assert (
+                new_server.tensor_snapshot.snapshot().content_key != feed_before
+            )
+            cold_before = engine.stats()["cold_solves"]
+            probe2 = Harness.static_allocation_spark_pods(
+                "post2", 2, creation_timestamp=t0 + 6
+            )[0]
+            h.api.create(probe2)
+            r2 = new_server.extender.predicate(
+                ExtenderArgs(pod=probe2, node_names=names)
+            )
+            assert r2.node_names
+            assert engine.stats()["cold_solves"] == cold_before + 1
+        finally:
+            new_server.stop()
+    finally:
+        try:
+            h.close()
+        except Exception:
+            pass
+
+
+@needs_native
+def test_engine_random_stream_decisions_match_engineless_twin():
+    """Five seeded random delta streams through the FULL extender:
+    schedule / fail / delete / cordon / relabel interleaved.  The
+    engine-on run must produce the identical decision sequence as the
+    engine-off twin."""
+    from k8s_spark_scheduler_tpu.config import Install
+
+    def run(enabled, seed):
+        rng = np.random.RandomState(seed)
+        if enabled:
+            h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+        else:
+            h = Harness(
+                extra_install=Install(
+                    fifo=True, binpack_algo="tpu-batch", delta_solve=False
+                )
+            )
+        decisions = []
+        try:
+            names = _cluster(h, n=6)
+            t0 = time.time()
+            _queue(h, int(rng.randint(3, 9)), t0)
+            live = []
+            for step in range(14):
+                op = rng.randint(0, 4)
+                if op == 0:  # schedule a fitting app
+                    p = h.static_allocation_spark_pods(
+                        f"a-{seed}-{step}", int(rng.randint(1, 4)),
+                        creation_timestamp=t0 + step,
+                    )[0]
+                    h.create_pod(p)
+                    r = h.schedule(p, names)
+                    decisions.append(("s", tuple(r.node_names or ()),
+                                      len(r.failed_nodes)))
+                    if r.node_names:
+                        live.append(p)
+                elif op == 1:  # an impossible gang: failure path
+                    p = h.static_allocation_spark_pods(
+                        f"x-{seed}-{step}", 400, creation_timestamp=t0 + step
+                    )[0]
+                    h.create_pod(p)
+                    r = h.schedule(p, names)
+                    decisions.append(("f", tuple(r.node_names or ()),
+                                      len(r.failed_nodes)))
+                elif op == 2 and live:  # app finishes
+                    p = live.pop(int(rng.randint(0, len(live))))
+                    h.api.delete("Pod", "default", p.name)
+                    rr = h.server.resource_reservation_cache
+                    deadline = time.monotonic() + 10
+                    app_id = p.labels.get("spark-app-id", "")
+                    while time.monotonic() < deadline:
+                        if rr.get("default", app_id) is None:
+                            break
+                        time.sleep(0.002)
+                    decisions.append(("d",))
+                else:  # cordon flip: structure churn
+                    node = h.api.get(
+                        "Node", "default", names[int(rng.randint(0, len(names)))]
+                    )
+                    node.unschedulable = not node.unschedulable
+                    h.api.update(node)
+                    decisions.append(("c",))
+        finally:
+            h.close()
+        return decisions
+
+    for seed in (101, 102, 103, 104, 105):
+        assert run(True, seed) == run(False, seed), f"seed {seed}"
+
+
+@needs_native
+def test_engine_scale_fallback_stays_exact():
+    """A warm session whose cached scale can't represent a new demand
+    exactly must rebuild (cold), never truncate: the decision equals the
+    engine-less one."""
+    from k8s_spark_scheduler_tpu.config import Install
+
+    def run(enabled):
+        if enabled:
+            h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+        else:
+            h = Harness(
+                extra_install=Install(
+                    fifo=True, binpack_algo="tpu-batch", delta_solve=False
+                )
+            )
+        try:
+            names = _cluster(h, n=4)
+            t0 = time.time()
+            # commensurate queue: whole-Gi memory, whole-cpu rows
+            _queue(h, 4, t0)
+            # created LAST (t0+10) so it never sits in odd's earlier
+            # queue — its failed solve only warms the session
+            big = h.static_allocation_spark_pods(
+                "bigx", 300, creation_timestamp=t0 + 10
+            )[0]
+            h.create_pod(big)
+            assert not h.schedule(big, names).node_names  # cold session
+            # a current app with 1.5Gi executors: likely indivisible by
+            # the cached Gi-scale — the engine must rescale, not round
+            odd = h.static_allocation_spark_pods(
+                "odd", 2, executor_mem="1536Mi", creation_timestamp=t0 + 1
+            )[0]
+            h.create_pod(odd)
+            r = h.schedule(odd, names)
+            stats = (
+                h.extender.delta_engine.stats()
+                if h.extender.delta_engine is not None
+                else None
+            )
+            return tuple(r.node_names or ()), stats
+        finally:
+            h.close()
+
+    on_nodes, stats = run(True)
+    off_nodes, _ = run(False)
+    assert on_nodes == off_nodes and on_nodes
+    assert stats["cold_solves"] >= 1
+
+
+# -- serde satellites ---------------------------------------------------------
+
+
+def test_node_names_interning_exact_and_bounded():
+    from k8s_spark_scheduler_tpu.types import serde
+
+    a = serde.intern_node_names(["n1", "n2", "n3"])
+    b = serde.intern_node_names(["n1", "n2", "n3"])
+    assert a is b and isinstance(a, tuple)
+    # same fingerprint (len, first, last, middle), different content:
+    # the exact verification must keep them distinct
+    c = serde.intern_node_names(["n1", "XX", "YY", "n3"])
+    d = serde.intern_node_names(["n1", "AA", "YY", "n3"])
+    assert c is not d and list(c) != list(d)
+    for i in range(64):
+        serde.intern_node_names([f"spill-{i}"])
+    assert (
+        serde.names_interner.size()
+        <= serde.names_interner.MAX_ENTRIES * serde.names_interner.MAX_PER_BUCKET
+    )
+    # interior churn under a STABLE fingerprint rotates the bucket
+    # instead of growing it (hot fingerprints are never LRU-evicted)
+    for i in range(32):
+        serde.intern_node_names(["head", f"mid-{i}", "mid", "tail"])
+    assert (
+        serde.names_interner.size()
+        <= serde.names_interner.MAX_ENTRIES * serde.names_interner.MAX_PER_BUCKET
+    )
+
+
+def test_uniform_failure_response_buffer_reuse():
+    import json
+
+    from k8s_spark_scheduler_tpu.types import serde
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderFilterResult
+
+    names = serde.intern_node_names([f"n{i}" for i in range(50)])
+    message = "earlier drivers do not fit to the cluster"
+    result = ExtenderFilterResult(
+        failed_nodes={n: message for n in names},
+        uniform_failure=(names, message),
+    )
+    first = serde.encode_extender_filter_result(result)
+    second = serde.encode_extender_filter_result(
+        ExtenderFilterResult(
+            failed_nodes={n: message for n in names},
+            uniform_failure=(names, message),
+        )
+    )
+    assert first is second  # the reusable buffer, not a re-serialization
+    decoded = json.loads(first)
+    assert decoded["FailedNodes"] == {n: message for n in names}
+    assert decoded["NodeNames"] is None
+    # non-uniform results never take the cached path
+    mixed = ExtenderFilterResult(failed_nodes={"n1": "a", "n2": "b"})
+    assert json.loads(serde.encode_extender_filter_result(mixed))[
+        "FailedNodes"
+    ] == {"n1": "a", "n2": "b"}

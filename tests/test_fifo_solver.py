@@ -124,9 +124,17 @@ def test_lazy_efficiencies_match_scalar_reference():
         e = lazy[name]
         assert e.cpu == want_cpu and e.memory == want_mem and e.gpu == want_gpu, name
         maxes.append(max(want_gpu, want_cpu, want_mem))
-    # builtin sum, like the extender's metric path (CPython 3.12's float
-    # sum() is Neumaier-compensated — a manual += loop differs by ulps)
-    assert lazy.seq_max_avg() == sum(maxes) / max(len(maxes), 1)
+    # Neumaier-compensated sum: the gauge's cross-lane bit-equality
+    # contract needs an order-robust reduction (different lanes sum the
+    # same maxes in different node orders), so seq_max_avg compensates
+    # regardless of what THIS interpreter's builtin sum() does (plain
+    # before CPython 3.12, Neumaier after)
+    s = c = 0.0
+    for x in maxes:
+        t = s + x
+        c += (s - t) + x if abs(s) >= abs(x) else (x - t) + s
+        s = t
+    assert lazy.seq_max_avg() == (s + c) / max(len(maxes), 1)
 
     # the full dict read protocol reflects all nodes, in node order,
     # regardless of which entries were materialized first

@@ -95,6 +95,73 @@ def test_native_lane_beats_xla_scan_by_4x():
     )
 
 
+# -- delta-solve warm-path guard ----------------------------------------------
+#
+# The persistent-session warm path must stay decisively cheaper than a
+# cold full solve: at the north-star 10k×1k shape the cold native queue
+# solve is ~19ms while a full-prefix warm resume is a few hundred µs
+# (checkpoint restore + prefix memcmp).  The CI bound is a relative 3×
+# (the bench acceptance bound) with the real shape, which also keeps the
+# guard load-robust — both paths run back-to-back on the same core.
+
+WARM_MIN_SPEEDUP = float(os.environ.get("PERF_GUARD_WARM_MIN_SPEEDUP", "3.0"))
+
+
+@pytest.mark.skipif(
+    not native_fifo_available(), reason="native toolchain unavailable"
+)
+def test_deltasolve_warm_path_beats_cold_solve_3x_at_10k_x_1k():
+    from k8s_spark_scheduler_tpu.native.fifo import (
+        NativeFifoSession,
+        native_session_available,
+    )
+
+    if not native_session_available():
+        pytest.skip("prebuilt native library lacks the session API")
+
+    nodes, apps = 10240, 1024
+    rng = np.random.RandomState(20260804)
+    avail = rng.randint(0, 400, size=(nodes, 3)).astype(np.int32)
+    rank = np.arange(nodes, dtype=np.int32)
+    rng.shuffle(rank)
+    eok = np.ones(nodes, dtype=bool)
+    packed = np.hstack(
+        [
+            rng.randint(0, 4, size=(apps, 3)),
+            rng.randint(1, 6, size=(apps, 3)),
+            rng.randint(1, 16, size=(apps, 1)),
+            np.ones((apps, 1), dtype=int),
+        ]
+    ).astype(np.int32)
+
+    sess = NativeFifoSession()
+    try:
+        def cold():
+            sess.load(avail, rank, eok, 0, stride=64)
+            return sess.solve(packed)
+
+        def warm():
+            return sess.solve(packed)
+
+        r0, feas_cold, _, after_cold = cold()
+        assert r0 == 0
+        r1, feas_warm, _, after_warm = warm()
+        assert r1 == apps  # full prefix reuse
+        np.testing.assert_array_equal(feas_warm, feas_cold)
+        np.testing.assert_array_equal(after_warm, after_cold)
+
+        cold_s = _best_of(cold)
+        warm_s = _best_of(warm)
+        speedup = cold_s / max(warm_s, 1e-9)
+        assert speedup >= WARM_MIN_SPEEDUP, (
+            f"warm-path regression: only {speedup:.1f}x faster than cold at "
+            f"{nodes}x{apps} (warm {warm_s * 1e3:.2f}ms vs cold "
+            f"{cold_s * 1e3:.1f}ms); bound is {WARM_MIN_SPEEDUP}x"
+        )
+    finally:
+        sess.close()
+
+
 # -- tracing overhead guard --------------------------------------------------
 #
 # The observability layer must never silently regress the predicate hot

@@ -134,6 +134,68 @@ def test_chaos_scenario_runs_clean_under_race_detector(monkeypatch):
     assert detector.clean()
 
 
+def test_chaos_with_delta_engine_enabled_runs_clean_and_bounded():
+    """The same degraded chaos scenario with the ``tpu-batch`` policy:
+    the delta-solve engine serves the driver fast path through outages,
+    kernel faults, and node churn with zero invariant violations, and
+    its resident native state stays bounded (the soak's bounded-size
+    contract, asserted here at tier-1 scale)."""
+    d = _chaos_dict()
+    d["name"] = "degraded-smoke-deltasolve"
+    d["binpack_algo"] = "tpu-batch"
+    sim = Simulation(Scenario.from_dict(d))
+    result = sim.run()
+    assert result.violations == []
+    assert result.summary["invariant_violations"] == 0
+    assert result.summary["decisions"] > 0
+    engine = sim.harness.server.extender.delta_engine
+    from k8s_spark_scheduler_tpu.native.fifo import native_session_available
+
+    if engine is None or not native_session_available():
+        return  # toolchain-less host: the fallback lanes already audited
+    stats = engine.stats()
+    # the engine was consulted (served or declined-with-reason) …
+    assert (
+        stats["cold_solves"] + stats["warm_hits"] + sum(stats["misses"].values())
+        > 0
+    )
+    # … and its resident state stayed bounded: session count at the LRU
+    # cap and native buffers within the per-session roof (basis + tail +
+    # working planes + ≤24 checkpoints + queue cache at this node scale)
+    assert stats["sessions"] <= engine.MAX_SESSIONS
+    max_nodes = 4096 + 16  # scenario cluster + autoscaler cap « bucket
+    assert stats["session_bytes"] <= engine.MAX_SESSIONS * (
+        30 * max_nodes * 12 + 2**21
+    )
+
+
+def test_chaos_with_delta_engine_runs_clean_under_race_detector(monkeypatch):
+    """The engine-enabled chaos scenario under the lockset detector: the
+    new guarded state (DeltaSolveEngine sessions/stats, the tensor
+    mirror's ChangeFeed, the serde intern/encoder caches) must produce
+    zero race reports and zero lock-order cycles alongside the usual
+    zero-violation audit."""
+    monkeypatch.setenv(racecheck.ENV_FLAG, "1")
+    racecheck.disable()
+    d = _chaos_dict()
+    d["name"] = "degraded-smoke-deltasolve-racecheck"
+    d["binpack_algo"] = "tpu-batch"
+    try:
+        result = Simulation(Scenario.from_dict(d)).run()
+    finally:
+        detector = racecheck.disable()
+    assert result.violations == []
+    assert detector is not None
+    tracked = {name.split("#")[0] for name in detector._instances.values()}
+    assert "ChangeFeed" in tracked, tracked
+    assert "DeltaSolveEngine" in tracked, tracked
+    assert detector.races == [], "\n".join(detector.report_lines())
+    assert detector.lock_order_violations == [], "\n".join(
+        detector.report_lines()
+    )
+    assert detector.clean()
+
+
 def test_degraded_example_scenario_parses():
     sc = Scenario.from_file(os.path.join(_EXAMPLES, "degraded.json"))
     kinds = {f.kind for f in sc.faults}

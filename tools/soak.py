@@ -220,6 +220,27 @@ def main() -> int:
     from k8s_spark_scheduler_tpu.kube.informer import Informer
 
     sel_n = len(scheduler.pod_informer._selector_revs)
+    # delta-solve engine + serde caches must stay bounded: sessions are a
+    # small LRU (native buffers accounted via fifo_sess_mem_bytes), the
+    # node-name interner holds a handful of shared tuples (the r5 soak's
+    # +95MB/hr was prep-cache/churn pinning fresh per-request JSON string
+    # copies — interning makes every cache share one set)
+    engine = scheduler.extender.delta_engine
+    engine_stats = engine.stats() if engine is not None else {}
+    intern_n = serde.names_interner.size()
+    uniform_n = serde.uniform_failure_encoder.size()
+    engine_ok = engine is None or (
+        engine_stats["sessions"] <= engine.MAX_SESSIONS
+        # generous absolute roof: MAX_SESSIONS x (basis+tail+working+24
+        # checkpoints) at the soak's node scale
+        and engine_stats["session_bytes"]
+        <= engine.MAX_SESSIONS * (30 * (args.nodes + 4096) * 12 + 2**21)
+    )
+    serde_ok = (
+        intern_n
+        <= serde.names_interner.MAX_ENTRIES * serde.names_interner.MAX_PER_BUCKET
+        and uniform_n <= serde.uniform_failure_encoder.MAX_ENTRIES
+    )
     # steady-state RSS growth (skip the first mark: warmup/compile)
     rss_growth_mb = (
         (rss_marks[-1] - rss_marks[1]) // 1024 if len(rss_marks) > 2 else 0
@@ -241,6 +262,8 @@ def main() -> int:
         and parse_n <= sparkpods._SPARK_RESOURCES_CACHE_MAX
         and sel_n <= Informer._SELECTOR_REVS_LIMIT
         and rss_growth_mb < 200
+        and engine_ok
+        and serde_ok
     )
     print(json.dumps({
         "cycles": cycle,
@@ -252,6 +275,9 @@ def main() -> int:
         "prep_cache": prep_n,
         "parse_cache": parse_n,
         "selector_revs": sel_n,
+        "deltasolve": engine_stats,
+        "names_interned": intern_n,
+        "uniform_response_buffers": uniform_n,
         "steady_rss_growth_mb": rss_growth_mb,
         "rss_growth_top3": growth_top,
         "ok": bool(ok),
