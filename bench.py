@@ -709,6 +709,7 @@ def cpu_fallback() -> dict:
     # fallback headline, with the XLA scan kept as a diagnostic
     native = _native_cpu_measure(problem)
     _deltasolve_measure(problem)
+    _provenance_measure(problem)
 
     args = _device_args(problem)
 
@@ -926,6 +927,88 @@ def _deltasolve_measure(problem) -> None:
             sess.close()
     except Exception as err:
         print(f"# deltasolve lane unavailable: {err}", file=sys.stderr)
+
+
+def _provenance_measure(problem) -> None:
+    """Provenance overhead contract (PR 6): the explain path (shortfall
+    + blocker replay at the bench shape) and the flight-recorder
+    note+persist cost, as their own diagnostic lane.  Explain is
+    on-demand (a refusal or an /explain request), so its budget is
+    'about one cold solve', not microseconds — the lane pins that it
+    stays in that regime; the perf guard separately pins the capture
+    cost on the request path at < 5% (enabled) / zero (disabled)."""
+    try:
+        from k8s_spark_scheduler_tpu.native.fifo import (
+            explain_queue_native,
+            native_explain_available,
+        )
+        from k8s_spark_scheduler_tpu.provenance.recorder import FlightRecorder
+        from k8s_spark_scheduler_tpu.provenance.tracker import SolveArtifacts
+
+        if not native_explain_available():
+            return
+        packed = np.hstack(
+            [
+                problem.driver, problem.executor,
+                problem.count[:, None],
+                problem.app_valid.astype(np.int32)[:, None],
+            ]
+        ).astype(np.int32)
+        target = int(packed.shape[0] - 1)
+        reps = max(ROUNDS, 10)
+        explain_ms = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            explain_queue_native(
+                problem.avail, problem.driver_rank, problem.exec_ok,
+                packed, 0, target,
+            )
+            explain_ms.append((time.perf_counter() - t0) * 1000.0)
+        n_earlier = target
+        art = SolveArtifacts(
+            policy_code=0,
+            lane="bench",
+            basis=problem.avail,
+            driver_rank=problem.driver_rank,
+            exec_ok=problem.exec_ok,
+            packed=packed,
+            n_earlier=n_earlier,
+            feasible=np.ones(n_earlier, dtype=bool),
+            didx=np.zeros(n_earlier, dtype=np.int32),
+            resume=0,
+            avail_after=problem.avail,
+        )
+        note_ms = []
+        with tempfile.TemporaryDirectory() as tmp:
+            rec = FlightRecorder(
+                capacity=8, out_dir=tmp, max_nodes=problem.avail.shape[0]
+            )
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                rec.note(art, "bench-pod", "failure-fit")
+                note_ms.append((time.perf_counter() - t0) * 1000.0)
+            t0 = time.perf_counter()
+            path = rec.persist("bench")
+            persist_ms = (time.perf_counter() - t0) * 1000.0
+            bundle_bytes = os.path.getsize(path) if path else 0
+        lat = np.array(explain_ms)
+        stats = _lane_stats(lat, 0)
+        stats["explain_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+        stats["recorder_note_p50_ms"] = round(
+            float(np.percentile(np.array(note_ms), 50)), 3
+        )
+        stats["persist_ms"] = round(persist_ms, 3)
+        stats["bundle_file_bytes"] = int(bundle_bytes)
+        LANES["provenance-explain cpu"] = stats
+        SECONDARY["provenance_explain_p50_ms"] = stats["explain_p50_ms"]
+        print(
+            f"# [provenance-explain cpu] explain_p50={stats['explain_p50_ms']}ms "
+            f"note_p50={stats['recorder_note_p50_ms']}ms "
+            f"persist={stats['persist_ms']}ms bundle={bundle_bytes}B",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        print(f"# provenance lane unavailable: {err}", file=sys.stderr)
 
 
 def _check_load() -> bool:

@@ -75,6 +75,45 @@ class ResilienceConfig:
 
 
 @dataclass
+class ProvenanceConfig:
+    """Decision provenance (provenance/): unschedulability explainer,
+    shortfall telemetry, anomaly flight recorder.
+
+    Diagnostic only — decisions are identical enabled or disabled.
+    ``bundle_dir`` (or the ``SCHED_PROVENANCE_DIR`` env var) is where
+    trigger-fired flight-recorder bundles persist; None keeps the
+    bundle ring in memory only.  ``parity_check_interval`` > 0 re-runs
+    every Nth warm delta-solve against the stateless cold solver and
+    fires the flight recorder on divergence (a full cold solve per
+    check — leave 0 in latency-sensitive production)."""
+
+    enabled: bool = True
+    ring_size: int = 128
+    recorder_size: int = 8
+    bundle_dir: Optional[str] = None
+    max_bundle_nodes: int = 4096
+    parity_check_interval: int = 0
+    # per-trigger persist debounce (seconds): an overload-driven trigger
+    # storm writes one bundle file per trigger type per interval, not
+    # one per failed request
+    trigger_min_interval_seconds: float = 30.0
+
+    @staticmethod
+    def from_dict(d: dict) -> "ProvenanceConfig":
+        return ProvenanceConfig(
+            enabled=d.get("enabled", True),
+            ring_size=d.get("ring-size", 128),
+            recorder_size=d.get("recorder-size", 8),
+            bundle_dir=d.get("bundle-dir"),
+            max_bundle_nodes=d.get("max-bundle-nodes", 4096),
+            parity_check_interval=d.get("parity-check-interval", 0),
+            trigger_min_interval_seconds=d.get(
+                "trigger-min-interval-seconds", 30.0
+            ),
+        )
+
+
+@dataclass
 class ConversionWebhookConfig:
     """Where the apiserver reaches the CRD conversion webhook (the
     reference wires this from the witchcraft server's service identity,
@@ -115,6 +154,9 @@ class Install:
     # fast path.  Decisions are identical either way (the kill switch
     # exists for operators, not semantics).
     delta_solve: bool = True
+    # decision provenance: explainer + shortfall telemetry + flight
+    # recorder (provenance/) — diagnostic only, decisions unchanged
+    provenance: ProvenanceConfig = field(default_factory=ProvenanceConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -186,4 +228,5 @@ class Install:
             ),
             delta_solve=d.get("delta-solve", True),
             resilience=ResilienceConfig.from_dict(d.get("resilience", {})),
+            provenance=ProvenanceConfig.from_dict(d.get("provenance", {})),
         )

@@ -94,6 +94,31 @@ DELTASOLVE_SESSION_BYTES = "foundry.spark.scheduler.tpu.deltasolve.session.bytes
 SERDE_INTERN_HITS = "foundry.spark.scheduler.serde.names.intern.hit.count"
 SERDE_INTERN_MISSES = "foundry.spark.scheduler.serde.names.intern.miss.count"
 
+# decision provenance (provenance/): unschedulability explainer,
+# shortfall telemetry, anomaly flight recorder
+# per-dimension cluster shortfall (executors short when that dimension
+# alone were the constraint), tagged dim=cpu|memory|nvidia.com/gpu
+PROVENANCE_SHORTFALL = "foundry.spark.scheduler.tpu.provenance.shortfall"
+# blocker-set size distribution of explained refusals
+PROVENANCE_BLOCKERS = "foundry.spark.scheduler.tpu.provenance.blockers"
+# explain invocations, tagged source=refusal|refusal-cached|http|debug
+PROVENANCE_EXPLAIN_COUNT = (
+    "foundry.spark.scheduler.tpu.provenance.explain.count"
+)
+# decision-record ring depth
+PROVENANCE_RECORDS = "foundry.spark.scheduler.tpu.provenance.records"
+# flight-recorder persists, tagged trigger=; bytes of the last bundle file
+PROVENANCE_BUNDLE_PERSISTED = (
+    "foundry.spark.scheduler.tpu.provenance.bundle.persisted.count"
+)
+PROVENANCE_BUNDLE_BYTES = (
+    "foundry.spark.scheduler.tpu.provenance.bundle.bytes"
+)
+# warm≠cold parity guard outcomes, tagged result=ok|mismatch
+PROVENANCE_PARITY_CHECKS = (
+    "foundry.spark.scheduler.tpu.provenance.parity.check.count"
+)
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"

@@ -14,6 +14,21 @@ Prometheus scraper (or ``promtool check metrics``) accepts:
   exact-tracked ``_max`` gauge.
 
 Content type: ``text/plain; version=0.0.4; charset=utf-8``.
+
+OpenMetrics flavour (``?format=openmetrics`` ONLY — never
+Accept-negotiated): the same families, terminated with ``# EOF``, with
+each histogram's ``_count`` line carrying a ``trace_id`` exemplar of
+the most recent in-trace observation — the link from a latency series
+back to the PR 1 span tree (``GET /traces`` /
+``/debug/schedule/<pod>``).  Plain Prometheus text output is
+byte-identical to before.  (Strict OpenMetrics attaches exemplars to
+counters and histogram buckets and requires ``_total`` counter
+samples; this flavour keeps the plain exposition's series names and
+carries the exemplar on the counter-like summary ``_count``, so a
+strict OpenMetrics parser — e.g. Prometheus with ``scrape_protocols:
+[OpenMetricsText1.0.0]`` — would reject it and fail the whole scrape.
+That is why Accept headers always get the plain 0.0.4 text —
+server/http.py ``_metrics_format``.)
 """
 
 from __future__ import annotations
@@ -22,6 +37,9 @@ import re
 from typing import Dict, Iterable, List, Tuple
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_OPENMETRICS = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
@@ -82,8 +100,24 @@ def _group(
     return grouped
 
 
-def render(registry) -> str:
-    """Render a MetricsRegistry into Prometheus text format."""
+def _exemplar_suffix(snap: dict, openmetrics: bool) -> str:
+    """OpenMetrics exemplar (`` # {trace_id="…"} value``) for a
+    histogram's ``_count`` line; empty in plain mode or when no in-trace
+    observation has been recorded."""
+    if not openmetrics:
+        return ""
+    ex = snap.get("exemplar")
+    if not ex:
+        return ""
+    trace_id, value = ex
+    return (
+        f' # {{trace_id="{escape_label_value(trace_id)}"}} {_fmt_value(value)}'
+    )
+
+
+def render(registry, openmetrics: bool = False) -> str:
+    """Render a MetricsRegistry into Prometheus text format (or the
+    OpenMetrics flavour with exemplars + ``# EOF`` when asked)."""
     collected = registry.collect()
     lines: List[str] = []
 
@@ -107,11 +141,18 @@ def render(registry) -> str:
                     f"{family}{_label_str(q_tags)} {_fmt_value(snap[key])}"
                 )
             lines.append(f"{family}_sum{_label_str(tags)} {_fmt_value(snap['sum'])}")
-            lines.append(f"{family}_count{_label_str(tags)} {_fmt_value(snap['count'])}")
+            lines.append(
+                f"{family}_count{_label_str(tags)} {_fmt_value(snap['count'])}"
+                f"{_exemplar_suffix(snap, openmetrics)}"
+            )
             max_lines.append(f"{family}_max{_label_str(tags)} {_fmt_value(snap['max'])}")
         # exact stream max isn't part of the summary type — expose it as
         # a sibling gauge family
         lines.append(f"# TYPE {family}_max gauge")
         lines.extend(max_lines)
 
+    if openmetrics:
+        # the terminator is mandatory even for an empty exposition — a
+        # scrape before the first recorded metric must still parse
+        lines.append("# EOF")
     return "\n".join(lines) + "\n" if lines else ""

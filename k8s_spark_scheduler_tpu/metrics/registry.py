@@ -15,6 +15,8 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Tuple
 
+from ..tracing.spans import current_trace_id
+
 TagSet = Tuple[Tuple[str, str], ...]
 
 # seeded per-histogram for reproducible quantiles in tests; the seed is
@@ -38,7 +40,7 @@ class Histogram:
     ~cap updates happened to be.)  max is tracked exactly, not sampled.
     """
 
-    __slots__ = ("values", "count", "total", "maximum", "_cap", "_rng")
+    __slots__ = ("values", "count", "total", "maximum", "_cap", "_rng", "exemplar")
 
     def __init__(self, cap: int = 2048):
         self.values: List[float] = []
@@ -47,6 +49,10 @@ class Histogram:
         self.maximum = 0.0
         self._cap = cap
         self._rng = random.Random(_RESERVOIR_SEED)
+        # (trace_id, observed value) of the most recent observation made
+        # inside an active trace — the OpenMetrics exemplar linking PR 1
+        # spans to this series (metrics/prometheus.py render_openmetrics)
+        self.exemplar: Tuple[str, float] | None = None
 
     def update(self, v: float) -> None:
         self.count += 1
@@ -94,12 +100,18 @@ class MetricsRegistry:
             self._gauges[(name, _tags(tags))] = value
 
     def histogram(self, name: str, value: float, tags: Dict[str, str] | None = None) -> None:
+        # trace correlation read OUTSIDE the registry lock (a contextvar
+        # read — ~100ns; None whenever no span is active, e.g. direct
+        # library use or background reporters)
+        trace_id = current_trace_id()
         with self._lock:
             key = (name, _tags(tags))
             h = self._histograms.get(key)
             if h is None:
                 h = self._histograms[key] = Histogram()
             h.update(value)
+            if trace_id is not None:
+                h.exemplar = (trace_id, float(value))
 
     def timer(self, name: str, tags: Dict[str, str] | None = None):
         """Context manager recording elapsed seconds into a histogram."""
@@ -151,7 +163,7 @@ class MetricsRegistry:
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "histograms": {
-                    k: dict(h.snapshot(), sum=h.total)
+                    k: dict(h.snapshot(), sum=h.total, exemplar=h.exemplar)
                     for k, h in self._histograms.items()
                 },
             }

@@ -105,6 +105,16 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 lib.fifo_sess_mem_bytes.argtypes = [_P]
             except AttributeError:
                 pass
+            try:
+                # decision-provenance explainer (PR 6) — optional for the
+                # same prebuilt-library reason as the session API
+                lib.fifo_explain_queue.restype = ctypes.c_int
+                lib.fifo_explain_queue.argtypes = [
+                    ctypes.c_int64, ctypes.c_int64, _P, _P, _P, _P,
+                    ctypes.c_int, ctypes.c_int64, _P, _P,
+                ]
+            except AttributeError:
+                pass
             _lib = lib
         except Exception:
             logger.warning(
@@ -281,6 +291,32 @@ POLICY_EVENLY = 1
 POLICY_MINFRAG = 2
 
 
+def solve_packed_cold(
+    policy_code: int,
+    avail: np.ndarray,        # [N, 3] int32 basis (not mutated)
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    apps_packed: np.ndarray,  # [A, 8] int32: d0..2 e0..2 count valid
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stateless cold solve of a session-format packed queue under the
+    given policy code — ONE dispatch shared by the delta-solve engine's
+    warm≠cold parity guard and the flight-recorder bundle replay, so the
+    policy-code → solver mapping can never diverge between the two
+    mechanisms whose job is proving solver equivalence."""
+    drv = apps_packed[:, 0:3]
+    exe = apps_packed[:, 3:6]
+    cnt = apps_packed[:, 6]
+    val = apps_packed[:, 7].astype(bool)
+    if policy_code == POLICY_MINFRAG:
+        return solve_queue_min_frag_native(
+            avail, driver_rank, exec_ok, drv, exe, cnt, val
+        )
+    return solve_queue_native(
+        avail, driver_rank, exec_ok, drv, exe, cnt, val,
+        evenly=(policy_code == POLICY_EVENLY),
+    )
+
+
 def native_session_available() -> bool:
     lib = _build_and_load()
     return lib is not None and hasattr(lib, "fifo_sess_create")
@@ -361,6 +397,77 @@ class NativeFifoSession:
         if not getattr(self, "_handle", None):
             return 0
         return int(self._lib.fifo_sess_mem_bytes(self._handle))
+
+
+def native_explain_available() -> bool:
+    lib = _build_and_load()
+    return lib is not None and hasattr(lib, "fifo_explain_queue")
+
+
+class ExplainResult:
+    """Decoded ``fifo_explain_queue`` output (provenance/explain.py).
+
+    ``flip`` is the queue position whose step turned the target
+    infeasible (-1 = feasible at its own position, -2 = infeasible even
+    against the empty basis); ``blockers`` is the per-position blocker
+    mask; the rest decompose the target-position probe (see the C++
+    entry-point comment for exact semantics)."""
+
+    __slots__ = (
+        "flip", "feasible", "cap_total", "dim_totals", "max_cap",
+        "max_node", "driver_fit", "tightest_dim", "shortfall_execs",
+        "blockers",
+    )
+
+    def __init__(self, info: np.ndarray, blockers: np.ndarray):
+        self.flip = int(info[0])
+        self.feasible = bool(info[1])
+        self.cap_total = int(info[2])
+        self.dim_totals = (int(info[3]), int(info[4]), int(info[5]))
+        self.max_cap = int(info[6])
+        self.max_node = int(info[7])
+        self.driver_fit = int(info[8])
+        self.tightest_dim = int(info[9])
+        self.shortfall_execs = int(info[10])
+        self.blockers = blockers
+
+    @property
+    def blocker_count(self) -> int:
+        return int(self.blockers.sum())
+
+
+def explain_queue_native(
+    avail: np.ndarray,        # [N, 3] int32 basis (queue position 0)
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    apps_packed: np.ndarray,  # [A, 8] int32: d0..2 e0..2 count valid
+    policy: int,
+    target: int,
+) -> Optional[ExplainResult]:
+    """Shortfall vector + blocker set for the app at queue position
+    ``target`` (see fifo_solver.cpp fifo_explain_queue), or None when
+    the library (or the symbol, in an older prebuilt) is unavailable or
+    the inputs are degenerate.  Diagnostic only — never a decision
+    input."""
+    lib = _build_and_load()
+    if lib is None or not hasattr(lib, "fifo_explain_queue"):
+        return None
+    av = np.ascontiguousarray(avail, dtype=np.int32)
+    rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    apps = np.ascontiguousarray(apps_packed, dtype=np.int32)
+    nb, na = av.shape[0], apps.shape[0]
+    if nb <= 0 or na <= 0 or not (0 <= target < na):
+        return None
+    blockers = np.zeros(na, dtype=np.uint8)
+    info = np.zeros(12, dtype=np.int64)
+    ok = lib.fifo_explain_queue(
+        nb, na, _c(av), _c(rank), _c(eok), _c(apps),
+        int(policy), int(target), _c(blockers), _c(info),
+    )
+    if not ok:
+        return None
+    return ExplainResult(info, blockers.astype(bool))
 
 
 def solve_app_native(

@@ -130,6 +130,18 @@ class DeltaSolveEngine:
         self._stats = {"warm_hits": 0, "cold_solves": 0, "misses": {}}
         self._resume_depths = deque(maxlen=1024)
         self._native_ok: Optional[bool] = None
+        # decision provenance (provenance/tracker.py): wiring points the
+        # sink at ProvenanceTracker.capture when provenance is enabled.
+        # None (the default) keeps the warm path entirely free of
+        # capture work.  All three are set before serving starts and
+        # only read here — no lock needed.
+        self.capture_sink = None
+        # warm≠cold parity guard: every Nth warm hit re-runs the queue
+        # through the stateless cold solver and fires the flight
+        # recorder on divergence.  0 = off (a full cold solve per check).
+        self.parity_interval = 0
+        self.parity_hooks = None  # (on_ok, on_mismatch) callables
+        self._parity_count = 0
 
     # -- availability --------------------------------------------------------
 
@@ -338,13 +350,25 @@ class DeltaSolveEngine:
             with default_profiler.profile(
                 "fifo_queue", lane="native-session", jit=False
             ):
-                resume, feasible, _didx, avail_after = sess.native.solve(
+                resume, feasible, didx, avail_after = sess.native.solve(
                     packed
                 )
             gate_span.tag("resumeFrom", int(resume))
             gate_span.tag("warm", warm)
             if warm:
                 self._record_warm(resume)
+                if self.parity_interval:
+                    self._parity_count += 1
+                    if self._parity_count % self.parity_interval == 0:
+                        self._verify_parity(
+                            sess, packed, feasible, didx, avail_after
+                        )
+            if self.capture_sink is not None:
+                self._capture(
+                    sess, snap, policy_code, packed, driver_s, executor_s,
+                    count_s, n_earlier, feasible, didx, resume,
+                    avail_after, earlier_skip_allowed,
+                )
             if n_earlier:
                 blocked = ~feasible & ~np.asarray(
                     earlier_skip_allowed, dtype=bool
@@ -375,6 +399,113 @@ class DeltaSolveEngine:
         return outcome, sess.zones
 
     # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _session_artifacts(
+        sess, packed, n_earlier, feasible, didx, resume, avail_after,
+        lane, skip_allowed=(), content_key=None, feed_seq=None,
+    ):
+        """One SolveArtifacts construction from session fields, shared
+        by the capture sink and the parity guard so the two bundles the
+        subsystem emits can never drift apart field-by-field.  Arrays
+        are referenced, not copied — the session's basis arrays are
+        replaced on rebuild, never mutated in place."""
+        from ..provenance.tracker import SolveArtifacts
+
+        return SolveArtifacts(
+            policy_code=sess.policy_code,
+            lane=lane,
+            basis=sess.scaled_avail,
+            driver_rank=sess.driver_rank,
+            exec_ok=sess.exec_ok,
+            packed=packed,
+            n_earlier=n_earlier,
+            feasible=np.asarray(feasible, dtype=bool),
+            didx=np.asarray(didx, dtype=np.int32),
+            resume=int(resume),
+            avail_after=np.asarray(avail_after, dtype=np.int32),
+            scale=sess.scale,
+            node_names=sess.cluster.node_names,
+            zone_names=sess.cluster.zone_names,
+            zone_id=sess.cluster.zone_id,
+            skip_allowed=list(skip_allowed),
+            content_key=content_key,
+            feed_seq=feed_seq,
+        )
+
+    def _capture(
+        self, sess, snap, policy_code, packed, driver_s, executor_s,
+        count_s, n_earlier, feasible, didx, resume, avail_after,
+        earlier_skip_allowed,
+    ) -> None:
+        """Hand the decision's full native inputs + verdicts to the
+        provenance sink."""
+        try:
+            packed_full = np.empty((n_earlier + 1, 8), dtype=np.int32)
+            packed_full[:n_earlier] = packed
+            packed_full[n_earlier, 0:3] = driver_s[n_earlier]
+            packed_full[n_earlier, 3:6] = executor_s[n_earlier]
+            packed_full[n_earlier, 6] = count_s[n_earlier]
+            packed_full[n_earlier, 7] = 1
+            self.capture_sink(self._session_artifacts(
+                sess, packed_full, n_earlier, feasible, didx, resume,
+                avail_after, lane="native-session",
+                skip_allowed=earlier_skip_allowed,
+                content_key=snap.content_key,
+                feed_seq=int(snap.content_key[1]),
+            ))
+        except Exception:
+            logger.exception("provenance capture failed (diagnostic only)")
+
+    def _verify_parity(
+        self, sess, packed, feasible, didx, avail_after
+    ) -> None:
+        """Warm≠cold parity guard: the stateless cold solver run on the
+        same basis + queue must reproduce the session's verdicts
+        byte-for-byte (the PR 5 shared-step-function guarantee, now
+        checked in the wild).  Divergence fires the flight recorder."""
+        try:
+            from ..native.fifo import solve_packed_cold
+
+            cold_f, cold_d, cold_after = solve_packed_cold(
+                sess.policy_code, sess.scaled_avail, sess.driver_rank,
+                sess.exec_ok, packed,
+            )
+            ok = (
+                cold_f.tobytes() == np.asarray(feasible, dtype=bool).tobytes()
+                and cold_d.tobytes() == np.asarray(didx, np.int32).tobytes()
+                and cold_after.tobytes()
+                == np.asarray(avail_after, np.int32).tobytes()
+            )
+            hooks = self.parity_hooks
+            if ok:
+                if hooks is not None and hooks[0] is not None:
+                    hooks[0]()
+                return
+            detail = {
+                "policy": sess.policy_code,
+                "n_apps": int(packed.shape[0]),
+                "feasible_equal": bool(
+                    cold_f.tobytes()
+                    == np.asarray(feasible, dtype=bool).tobytes()
+                ),
+            }
+            logger.error("deltasolve warm/cold parity mismatch: %s", detail)
+            if hooks is not None and hooks[1] is not None:
+                # ship the DIVERGING solve itself: the persisted bundle
+                # must contain the anomaly, not just the decisions that
+                # preceded it (the tracker notes these artifacts into
+                # the recorder ring before persisting)
+                try:
+                    detail["artifacts"] = self._session_artifacts(
+                        sess, packed, int(packed.shape[0]), feasible,
+                        didx, 0, avail_after, lane="native-session-parity",
+                    )
+                except Exception:
+                    pass
+                hooks[1](detail)
+        except Exception:
+            logger.exception("parity guard failed to run (diagnostic only)")
 
     @staticmethod
     def _scale_apps(apps, scale: np.ndarray, nb: int):

@@ -283,6 +283,10 @@ class TpuFifoSolver:
         # proves the SAME AppDemand objects (stable per pod version via
         # sparkpods._cached_entry), making the hit exact.
         self._earlier_tensor_cache = None
+        # decision provenance (provenance/tracker.py): wiring points
+        # this at ProvenanceTracker.capture when provenance is enabled;
+        # None (the default) keeps solve_tensor capture-free.
+        self.capture_sink = None
 
     def _use_pallas(self) -> bool:
         return _pallas_selected(self.backend)
@@ -408,6 +412,7 @@ class TpuFifoSolver:
         use_native = self._use_native()
 
         shape_key = (problem.avail.shape, problem.driver.shape)
+        didx_all = None  # native lanes keep per-position driver indices
         if n_earlier > 0:
             # whole-queue pass over the earlier drivers only.  The
             # fifo_gate span is the request's "earlier drivers fit?"
@@ -425,7 +430,7 @@ class TpuFifoSolver:
                     with default_profiler.profile(
                         "fifo_queue", lane="native-minfrag", jit=False
                     ):
-                        feasible_all, _, avail_after = solve_queue_min_frag_native(
+                        feasible_all, didx_all, avail_after = solve_queue_min_frag_native(
                             problem.avail, problem.driver_rank, problem.exec_ok,
                             problem.driver, problem.executor, problem.count,
                             queue_valid,
@@ -438,7 +443,7 @@ class TpuFifoSolver:
                     with default_profiler.profile(
                         "fifo_queue", lane="native", jit=False
                     ):
-                        feasible_all, _, avail_after = solve_queue_native(
+                        feasible_all, didx_all, avail_after = solve_queue_native(
                             problem.avail, problem.driver_rank, problem.exec_ok,
                             problem.driver, problem.executor, problem.count,
                             queue_valid, evenly=evenly,
@@ -499,6 +504,14 @@ class TpuFifoSolver:
                         feasible = np.asarray(out.feasible)[:n_earlier]
                         avail_after = out.avail_after
                 gate_span.tag("lane", self.last_queue_lane)
+                # capture BEFORE the blocked-earlier verdict below: a
+                # FAILURE_EARLIER_DRIVER refusal is exactly the decision
+                # the provenance explainer must be able to decompose
+                if self.capture_sink is not None:
+                    self._capture_solve(
+                        cluster, problem, earlier_skip_allowed, n_earlier,
+                        feasible, didx_all, avail_after,
+                    )
                 # an enforced (old-enough) earlier driver that doesn't fit
                 # fails the whole request (resource.go:244-253)
                 for i in range(n_earlier):
@@ -509,11 +522,62 @@ class TpuFifoSolver:
         else:
             with tracing.child_span("fifo_gate", {"earlierApps": 0, "earlierOk": True}):
                 avail_after = problem.avail if use_native else jnp.asarray(problem.avail)
+            feasible = np.zeros(0, dtype=bool)
+            if self.capture_sink is not None:
+                self._capture_solve(
+                    cluster, problem, earlier_skip_allowed, n_earlier,
+                    feasible, didx_all, avail_after,
+                )
 
         return self._pack_current(
             cluster, problem, avail_after, n_earlier, current_app,
             metadata=metadata, use_native=use_native,
         )
+
+    def _capture_solve(
+        self, cluster, problem, earlier_skip_allowed, n_earlier,
+        feasible, didx_all, avail_after,
+    ) -> None:
+        """Hand the queue solve's inputs + verdicts to the provenance
+        sink (provenance/tracker.py).  Array references, no copies; only
+        runs when wiring installed a sink."""
+        try:
+            from .batch_solver import queue_policy_code
+            from ..provenance.tracker import SolveArtifacts
+
+            policy_code = queue_policy_code(self.assignment_policy)
+            if policy_code is None:
+                return
+            na = n_earlier + 1
+            packed = np.empty((na, 8), dtype=np.int32)
+            packed[:, 0:3] = problem.driver[:na]
+            packed[:, 3:6] = problem.executor[:na]
+            packed[:, 6] = problem.count[:na]
+            packed[:, 7] = problem.app_valid[:na]
+            self.capture_sink(SolveArtifacts(
+                policy_code=int(policy_code),
+                lane=self.last_queue_lane or "none",
+                basis=problem.avail,
+                driver_rank=problem.driver_rank,
+                exec_ok=problem.exec_ok,
+                packed=packed,
+                n_earlier=n_earlier,
+                feasible=np.asarray(feasible, dtype=bool),
+                didx=(
+                    np.asarray(didx_all, dtype=np.int32)
+                    if didx_all is not None
+                    else None
+                ),
+                resume=0,
+                avail_after=np.asarray(avail_after, dtype=np.int32),
+                scale=problem.scale,
+                node_names=cluster.node_names,
+                zone_names=cluster.zone_names,
+                zone_id=cluster.zone_id,
+                skip_allowed=list(earlier_skip_allowed),
+            ))
+        except Exception:
+            logger.exception("provenance capture failed (diagnostic only)")
 
     def _pack_current(
         self,

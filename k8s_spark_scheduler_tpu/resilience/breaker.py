@@ -52,6 +52,10 @@ class CircuitBreaker:
         self._consecutive_failures = 0
         self._opened_at = 0.0
         self._probe_in_flight = False
+        # optional observer invoked (OUTSIDE the breaker lock — it may
+        # do file I/O) when the breaker transitions to open; wiring
+        # points it at the provenance flight recorder
+        self.on_open = None
 
     @property
     def state(self) -> str:
@@ -95,6 +99,7 @@ class CircuitBreaker:
             self._probe_in_flight = False
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             racecheck.note_access(self, "_state")
             self._consecutive_failures += 1
@@ -104,11 +109,21 @@ class CircuitBreaker:
                 and self._consecutive_failures >= self.failure_threshold
             ):
                 self._opened_at = timesource.now()
+                opened = True  # this branch only runs CLOSED/HALF_OPEN → OPEN
                 self._set_state(OPEN)
             elif self._state == OPEN:
                 # a straggler failure while already open refreshes nothing:
                 # the cooloff runs from the instant the breaker opened
                 pass
+        if opened and self.on_open is not None:
+            try:
+                self.on_open(self._name)
+            except Exception:  # observers must never break the write path
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "breaker on_open observer failed"
+                )
 
     def is_open(self) -> bool:
         with self._lock:

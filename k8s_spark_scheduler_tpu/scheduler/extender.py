@@ -110,6 +110,7 @@ class SparkSchedulerExtender:
         tracer: Optional[tracing.Tracer] = None,
         resilience=None,
         delta_solve: bool = True,
+        provenance=None,
     ):
         self._node_informer = node_informer
         self._pod_lister = pod_lister
@@ -152,6 +153,16 @@ class SparkSchedulerExtender:
         self._strict_reference_parity = strict_reference_parity
         self._resilience = resilience
         self._lane_health = resilience.lanes if resilience is not None else None
+        # decision provenance (provenance/tracker.py): None or disabled
+        # keeps every capture sink None — the solver lanes then run with
+        # zero provenance work (the perf guard pins this)
+        self._provenance = provenance
+        if provenance is not None and provenance.enabled:
+            solver = getattr(binpacker, "queue_solver", None)
+            if solver is not None and hasattr(solver, "capture_sink"):
+                solver.capture_sink = provenance.capture
+            if self.delta_engine is not None:
+                self.delta_engine.capture_sink = provenance.capture
         self._last_request = 0.0
         # diagnostics: which lane served the last executor reschedule
         self.last_reschedule_path: Optional[str] = None
@@ -175,6 +186,11 @@ class SparkSchedulerExtender:
                     self._check_deadline("lock-acquired")
                 except SchedulingFailure as err:
                     tracing.add_tag("outcome", err.outcome)
+                    if self._provenance is not None and self._provenance.enabled:
+                        self._provenance.on_trigger(
+                            "deadline-exceeded",
+                            f"{args.pod.namespace}/{args.pod.name} at lock-acquired",
+                        )
                     return self._fail_with_message(err.outcome, args, str(err))
                 return self._predicate_locked(args)
 
@@ -226,12 +242,17 @@ class SparkSchedulerExtender:
         instance_group, ok = L.find_instance_group_from_pod_spec(pod, self._instance_group_label)
         if not ok:
             instance_group = ""
+        if self._provenance is not None and self._provenance.enabled:
+            self._provenance.begin_decision(pod, role=role)
 
         t0 = time.perf_counter()
         try:
             self._reconcile_if_needed()
         except Exception as err:
             logger.exception("failed to reconcile")
+            self._finish_provenance(
+                FAILURE_INTERNAL, instance_group, message="failed to reconcile"
+            )
             return self._fail_with_message(FAILURE_INTERNAL, args, "failed to reconcile")
         self._rrm.compact_dynamic_allocation_applications()
 
@@ -239,6 +260,7 @@ class SparkSchedulerExtender:
             node_name, outcome = self._select_node(instance_group, role, pod, args.node_names)
         except SchedulingFailure as err:
             self._mark_schedule(instance_group, role, err.outcome, t0, pod)
+            self._finish_provenance(err.outcome, instance_group, message=str(err))
             if err.outcome == FAILURE_INTERNAL:
                 logger.exception("internal error scheduling pod %s", pod.name)
             else:
@@ -246,6 +268,7 @@ class SparkSchedulerExtender:
             return self._fail_with_message(err.outcome, args, str(err))
 
         self._mark_schedule(instance_group, role, outcome, t0, pod)
+        self._finish_provenance(outcome, instance_group, node=node_name)
         tracing.add_tag("node", node_name)
 
         if role == L.DRIVER:
@@ -310,6 +333,42 @@ class SparkSchedulerExtender:
                     wait,
                     outcome,
                 )
+
+    def _finish_provenance(
+        self, outcome: str, instance_group: str, node: str = "", message: str = ""
+    ) -> None:
+        """Seal the pending decision record (provenance/tracker.py) and
+        fire the deadline flight-recorder trigger when the decision died
+        at a phase boundary."""
+        prov = self._provenance
+        if prov is None or not prov.enabled:
+            return
+        # lane comes from the captured artifacts when a queue solve ran
+        # for THIS decision; passing the solver's last_queue_lane here
+        # would stamp artifact-less decisions (executor replays, early
+        # failures) with a stale lane from a previous driver solve
+        prov.finish_decision(
+            outcome,
+            node=node,
+            lane="",
+            policy=self.binpacker.name,
+            instance_group=instance_group,
+            message=message,
+        )
+        if outcome == FAILURE_DEADLINE:
+            prov.on_trigger("deadline-exceeded", message)
+
+    def _refusal_message(self, base: str, kind: str) -> str:
+        """Thread the tightest-dimension shortfall + blocker set into
+        the shared failure message ("short 12 executors … in cpu;
+        blocked by 3 earlier drivers").  The enriched message flows
+        through uniform_failure into the PR 5 encode-once buffer — one
+        serialization per (candidates, message) pair, unchanged."""
+        prov = self._provenance
+        if prov is None or not prov.enabled:
+            return base
+        detail = prov.refusal_detail(kind)
+        return f"{base}: {detail}" if detail else base
 
     def _fail_with_message(self, outcome: str, args: ExtenderArgs, message: str) -> ExtenderFilterResult:
         if self._waste_reporter is not None:
@@ -387,7 +446,11 @@ class SparkSchedulerExtender:
                     driver, app_resources_early
                 )
                 raise SchedulingFailure(
-                    FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
+                    FAILURE_EARLIER_DRIVER,
+                    self._refusal_message(
+                        "earlier drivers do not fit to the cluster",
+                        "earlier-driver",
+                    ),
                 )
             return self._finish_driver_selection(
                 instance_group, driver, app_resources_early, outcome.result, zones
@@ -434,7 +497,11 @@ class SparkSchedulerExtender:
             if not earlier_ok:
                 self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
                 raise SchedulingFailure(
-                    FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
+                    FAILURE_EARLIER_DRIVER,
+                    self._refusal_message(
+                        "earlier drivers do not fit to the cluster",
+                        "earlier-driver",
+                    ),
                 )
 
         if packing_result is None:
@@ -469,7 +536,12 @@ class SparkSchedulerExtender:
         self._check_deadline("reservation-writeback")
         if not packing_result.has_capacity:
             self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
-            raise SchedulingFailure(FAILURE_FIT, "application does not fit to the cluster")
+            raise SchedulingFailure(
+                FAILURE_FIT,
+                self._refusal_message(
+                    "application does not fit to the cluster", "fit"
+                ),
+            )
 
         if efficiency is None:
             if packing_result.max_avg_efficiency is not None:
@@ -527,8 +599,12 @@ class SparkSchedulerExtender:
 
             snap = self._tensor_snapshot.snapshot()
 
+            prov = self._provenance
+            if prov is not None and not prov.enabled:
+                prov = None
             earlier_apps = []
             skip_allowed = []
+            queue_names: Optional[List[str]] = [] if prov is not None else None
             if self._is_fifo:
                 skip_cutoff = self._fifo_skip_cutoff(instance_group)
                 for queued in self._pod_lister.list_earlier_drivers(driver):
@@ -544,6 +620,14 @@ class SparkSchedulerExtender:
                         continue
                     earlier_apps.append(demand)
                     skip_allowed.append(queued.creation_timestamp > skip_cutoff)
+                    if queue_names is not None:
+                        queue_names.append(queued.name)
+            if prov is not None:
+                prov.note_context(
+                    queue_names=queue_names,
+                    content_key=snap.content_key,
+                    feed_seq=int(snap.content_key[1]),
+                )
             current = AppDemand(
                 app_resources.driver_resources,
                 app_resources.executor_resources,
@@ -622,8 +706,12 @@ class SparkSchedulerExtender:
             return None  # demoted: the host earlier-drivers loop serves
         from ..ops.sparkapp import AppDemand
 
+        prov = self._provenance
+        if prov is not None and not prov.enabled:
+            prov = None
         earlier_apps = []
         skip_allowed = []
+        queue_names: Optional[List[str]] = [] if prov is not None else None
         skip_cutoff = self._fifo_skip_cutoff(instance_group)
         for queued in queued_drivers:
             try:
@@ -635,6 +723,10 @@ class SparkSchedulerExtender:
                 continue
             earlier_apps.append(demand)
             skip_allowed.append(queued.creation_timestamp > skip_cutoff)
+            if queue_names is not None:
+                queue_names.append(queued.name)
+        if prov is not None:
+            prov.note_context(queue_names=queue_names)
         t0 = time.perf_counter()
         try:
             check_kernel_fault("device_fifo")

@@ -12,7 +12,11 @@
   ``text/plain``/openmetrics or ``?format=prometheus`` is passed
 - ``GET /traces`` — recent completed span trees (tracing/spans.py ring)
 - ``GET /debug/schedule/<pod>`` — human-readable explanation of the
-  last scheduling decision for a pod: span tree + correlated events
+  last scheduling decision for a pod: span tree + correlated events +
+  the decision-provenance record when one exists
+- ``GET /explain/<pod>`` — the decision-provenance record as JSON:
+  snapshot keys, queue slice, verdicts, and for refusals the
+  tightest-dimension shortfall + blocker set (provenance/)
 """
 
 from __future__ import annotations
@@ -168,7 +172,16 @@ class _Handler(BaseHTTPRequestHandler):
             report["ready"] = serving
             self._send_json(200 if serving else 503, report)
         elif path == "/metrics" and self.scheduler is not None:
-            if self._wants_prometheus(query):
+            fmt = self._metrics_format(query)
+            if fmt == "openmetrics":
+                from ..metrics import prometheus as prom
+
+                self._send_text(
+                    200,
+                    prom.render(self.scheduler.metrics, openmetrics=True),
+                    prom.CONTENT_TYPE_OPENMETRICS,
+                )
+            elif fmt == "prometheus":
                 from ..metrics import prometheus as prom
 
                 self._send_text(
@@ -189,6 +202,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"traces": tracer.traces(limit=limit)})
         elif path.startswith("/debug/schedule/") and self.scheduler is not None:
             self._handle_debug_schedule(unquote(path[len("/debug/schedule/"):]))
+        elif path.startswith("/explain/") and self.scheduler is not None:
+            self._handle_explain(unquote(path[len("/explain/"):]))
         else:
             self._send_json(404, {"error": "not found"})
 
@@ -196,17 +211,59 @@ class _Handler(BaseHTTPRequestHandler):
         parts = urlsplit(self.path)
         return parts.path, parse_qs(parts.query)
 
-    def _wants_prometheus(self, query) -> bool:
+    def _metrics_format(self, query) -> str:
+        """"openmetrics" (exemplar-carrying text), "prometheus" (plain
+        0.0.4 text, unchanged), or "json" (the default snapshot).
+
+        The exemplar flavour is EXPLICIT opt-in (?format=openmetrics),
+        never Accept-negotiated: it is pragmatic rather than strictly
+        OpenMetrics-valid (exemplars ride on summary ``_count`` lines;
+        counter samples keep their plain-text names), so routing it to
+        a client whose Accept demands strict OpenMetrics — including a
+        Prometheus configured with ``scrape_protocols:
+        [OpenMetricsText1.0.0]`` — would fail its whole scrape.  Any
+        Accept mentioning openmetrics or text/plain gets the plain
+        0.0.4 text every Prometheus parses."""
         fmt = query.get("format", [""])[0] if query.get("format") else ""
         if fmt:
-            return fmt in ("prometheus", "text")
+            if fmt == "openmetrics":
+                return "openmetrics"
+            return "prometheus" if fmt in ("prometheus", "text") else "json"
         accept = self.headers.get("Accept") or ""
-        return "text/plain" in accept or "openmetrics" in accept
+        if "text/plain" in accept or "openmetrics" in accept:
+            return "prometheus"
+        return "json"
+
+    def _handle_explain(self, pod_name: str) -> None:
+        """Why was this pod's last scheduling decision what it was:
+        the provenance record — snapshot keys, queue slice, verdicts,
+        and for refusals the tightest-dimension shortfall + blocker set
+        (provenance/tracker.py).  Accepts a bare pod name (newest match
+        across namespaces) or ``<namespace>/<pod>`` to disambiguate."""
+        tracker = getattr(self.scheduler, "provenance", None)
+        if tracker is None or not getattr(tracker, "enabled", False):
+            self._send_json(404, {"error": "provenance not enabled"})
+            return
+        if not pod_name:
+            self._send_json(400, {"error": "usage: /explain/<pod-name>"})
+            return
+        record = tracker.explain(pod_name)
+        if record is None:
+            self._send_json(
+                404,
+                {
+                    "error": f"no recorded decision for pod {pod_name!r}",
+                    "ringSize": tracker.stats()["ring"]["size"],
+                },
+            )
+            return
+        self._send_json(200, record)
 
     def _handle_debug_schedule(self, pod_name: str) -> None:
         """Explain the last scheduling decision for a pod: the newest
         trace tagged pod=<name> rendered as a text span tree, with the
-        event-ring records of the same trace appended."""
+        event-ring records of the same trace appended, and the decision-
+        provenance record (shortfall + blockers) when one exists."""
         tracer = self._tracer()
         if tracer is None or not pod_name:
             self._send_json(404, {"error": "tracing not enabled"})
@@ -223,7 +280,22 @@ class _Handler(BaseHTTPRequestHandler):
             (e.name, e.values)
             for e in self.scheduler.event_log.by_trace_id(trace["traceId"])
         ]
-        self._send_text(200, tracing.render_trace_text(trace, events))
+        text = tracing.render_trace_text(trace, events)
+        tracker = getattr(self.scheduler, "provenance", None)
+        if tracker is not None and getattr(tracker, "enabled", False):
+            record = tracker.explain(pod_name, source="debug")
+            if record is not None:
+                text += "\nprovenance:\n"
+                summary = record.get("summary")
+                if summary:
+                    text += f"  why: {summary}\n"
+                for key in (
+                    "outcome", "lane", "policy", "feedSeq", "queueLength",
+                    "bundleSeq",
+                ):
+                    if record.get(key) is not None:
+                        text += f"  {key}: {record[key]}\n"
+        self._send_text(200, text)
 
     def _begin_trace(self, open_span: bool = True):
         # request tracing (the reference's witchcraft request log / trc1

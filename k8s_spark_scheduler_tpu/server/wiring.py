@@ -66,6 +66,7 @@ class Server:
     reporters: "ReporterSet" = None
     waste_reporter: "WasteMetricsReporter" = None
     resilience: ResilienceKit = None
+    provenance: object = None  # ProvenanceTracker (provenance/tracker.py)
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
@@ -403,6 +404,30 @@ def init_server_with_clients(
     waste_reporter = WasteMetricsReporter(metrics, install.instance_group_label)
     waste_reporter.start(pod_informer, lazy_demand_informer)
 
+    # decision provenance: unschedulability explainer + shortfall
+    # telemetry + anomaly flight recorder (provenance/)
+    provenance_tracker = None
+    if install.provenance.enabled:
+        from ..provenance.tracker import ProvenanceTracker
+
+        provenance_tracker = ProvenanceTracker(
+            enabled=True,
+            ring_size=install.provenance.ring_size,
+            recorder_size=install.provenance.recorder_size,
+            bundle_dir=install.provenance.bundle_dir,
+            max_bundle_nodes=install.provenance.max_bundle_nodes,
+            metrics=metrics,
+            trigger_min_interval=install.provenance.trigger_min_interval_seconds,
+        )
+        # write-back breaker opening is a flight-recorder trigger: the
+        # recent decisions leading into an open breaker are exactly the
+        # forensic record an operator wants
+        resilience_kit.breaker.on_open = (
+            lambda name: provenance_tracker.on_trigger(
+                "breaker-open", f"breaker {name} opened"
+            )
+        )
+
     # extender (cmd/server.go:171-191)
     node_sorter = NodeSorter(
         install.driver_prioritized_node_label, install.executor_prioritized_node_label
@@ -431,7 +456,19 @@ def init_server_with_clients(
         tracer=tracer,
         resilience=resilience_kit,
         delta_solve=install.delta_solve,
+        provenance=provenance_tracker,
     )
+    if provenance_tracker is not None and extender.delta_engine is not None:
+        # warm≠cold parity guard: every Nth warm hit re-proves the
+        # session verdicts against the stateless cold solver and fires
+        # the flight recorder on divergence (0 = off)
+        extender.delta_engine.parity_interval = (
+            install.provenance.parity_check_interval
+        )
+        extender.delta_engine.parity_hooks = (
+            provenance_tracker.on_parity_ok,
+            provenance_tracker.on_parity_mismatch,
+        )
     marker = UnschedulablePodMarker(
         api,
         node_informer,
@@ -465,6 +502,7 @@ def init_server_with_clients(
         tracer=tracer,
         waste_reporter=waste_reporter,
         resilience=resilience_kit,
+        provenance=provenance_tracker,
     )
     server.reporters = ReporterSet(server)
 

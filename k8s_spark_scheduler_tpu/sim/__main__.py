@@ -10,6 +10,12 @@ diff two event logs to bisect a behavior change.
 
 ``--dump-trace`` writes the generated workload as JSONL; a scenario
 whose ``workload`` is ``{"trace": "path.jsonl"}`` replays it verbatim.
+
+``--replay-bundle <path.jsonl>`` replays a flight-recorder bundle file
+(provenance/recorder.py): every recorded decision re-runs through the
+stateless cold native solver AND a fresh persistent session (warm lane,
+twice), asserting byte-identical verdicts.  Exit 0 = every bundle
+reproduced exactly; a mismatch prints the diverging lane and exits 1.
 """
 
 from __future__ import annotations
@@ -24,20 +30,52 @@ from .scenario import Scenario
 from .workload import WorkloadGenerator, dump_trace
 
 
+def _replay_bundles(path: str, quiet: bool = False) -> int:
+    from ..provenance.recorder import replay_bundle_file
+
+    results = replay_bundle_file(path)
+    failed = [r for r in results if not r["ok"]]
+    if not quiet:
+        for r in results:
+            status = "ok" if r["ok"] else "MISMATCH"
+            lanes = ",".join(f"{k}={v}" for k, v in sorted(r["lanes"].items()))
+            print(
+                f"bundle seq={r['seq']} pod={r['pod']} policy={r['policy']} "
+                f"nEarlier={r['nEarlier']} [{lanes}] {status}"
+            )
+            for m in r["mismatches"]:
+                print(f"  MISMATCH: {m}", file=sys.stderr)
+    print(
+        f"replayed {len(results)} bundles: "
+        f"{len(results) - len(failed)} byte-identical, {len(failed)} diverged"
+    )
+    return 1 if failed or not results else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m k8s_spark_scheduler_tpu.sim",
         description="deterministic discrete-event cluster simulator",
     )
-    parser.add_argument("--scenario", required=True, help="scenario JSON path")
+    parser.add_argument("--scenario", default=None, help="scenario JSON path")
     parser.add_argument("--seed", type=int, default=None, help="override the scenario seed")
     parser.add_argument("--out", default=None, help="output directory (events.jsonl, summary.json)")
     parser.add_argument(
         "--dump-trace", default=None, metavar="PATH",
         help="write the generated workload trace as JSONL and exit",
     )
+    parser.add_argument(
+        "--replay-bundle", default=None, metavar="PATH",
+        help="replay a flight-recorder bundle file and assert "
+        "byte-identical verdicts (no scenario needed)",
+    )
     parser.add_argument("--quiet", action="store_true", help="suppress the summary dump")
     args = parser.parse_args(argv)
+
+    if args.replay_bundle:
+        return _replay_bundles(args.replay_bundle, quiet=args.quiet)
+    if not args.scenario:
+        parser.error("--scenario is required (unless --replay-bundle)")
 
     scenario = Scenario.from_file(args.scenario)
     if args.seed is not None:
@@ -49,7 +87,8 @@ def main(argv=None) -> int:
         print(f"wrote {len(apps)} apps to {args.dump_trace}")
         return 0
 
-    result = Simulation(scenario).run()
+    bundle_dir = os.path.join(args.out, "bundles") if args.out else None
+    result = Simulation(scenario, bundle_dir=bundle_dir).run()
 
     if args.out:
         os.makedirs(args.out, exist_ok=True)

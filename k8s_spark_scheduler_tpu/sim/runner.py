@@ -70,8 +70,13 @@ class SimulationResult:
 
 
 class Simulation:
-    def __init__(self, scenario: Scenario):
+    def __init__(self, scenario: Scenario, bundle_dir: Optional[str] = None):
         self.scenario = scenario
+        # where the extender's flight recorder persists decision bundles
+        # when a sim trigger fires (invariant violation); None keeps the
+        # bundle ring in memory only
+        self.bundle_dir = bundle_dir
+        self._violations_seen = 0
         self.clock = VirtualClock(start=SIM_EPOCH)
         self._rng = random.Random(scenario.seed ^ 0xFA17)
         self._apps: Dict[str, _App] = {}
@@ -157,6 +162,9 @@ class Simulation:
                 deferred=True,  # determinism: fulfill only at virtual pumps
             )
         self.auditor = Auditor(self.harness.server)
+        tracker = getattr(self.harness.server, "provenance", None)
+        if tracker is not None and self.bundle_dir:
+            tracker.recorder.out_dir = self.bundle_dir
 
     def _seed_events(self) -> None:
         sc = self.scenario
@@ -616,6 +624,7 @@ class Simulation:
         self._quiesce(label)
         self.auditor.check_round(decisions, label)
         self.auditor.check_state(label)
+        self._fire_invariant_trigger(label)
         self._schedule_scaler_pumps()
         # one API listing per kind per event, shared by the depth gauge,
         # the log entry, and the fingerprint (APIServer.list deepcopies
@@ -653,7 +662,21 @@ class Simulation:
     def _audit_only(self, label: str) -> None:
         self._quiesce(label)
         self.auditor.check_state(label)
+        self._fire_invariant_trigger(label)
         self._schedule_scaler_pumps()
+
+    def _fire_invariant_trigger(self, label: str) -> None:
+        """An invariant violation is a flight-recorder trigger: persist
+        the recent decision bundles so the violating decision replays
+        outside the sim (provenance/recorder.py)."""
+        n = len(self.auditor.violations)
+        if n <= self._violations_seen:
+            return
+        fresh = self.auditor.violations[self._violations_seen:n]
+        self._violations_seen = n
+        tracker = getattr(self.harness.server, "provenance", None)
+        if tracker is not None:
+            tracker.on_trigger("sim-invariant", f"{label}: {fresh[0]}")
 
     def _quiesce(self, label: str) -> None:
         h = self.harness

@@ -26,6 +26,10 @@ class ExtenderFilterResult:
     # all-candidates map — lets serde reuse an encoded response buffer
     # keyed by the interned tuple's identity (serde.encode_extender_
     # filter_result).  Purely an encoding hint; to_dict ignores it.
+    # The shared message carries the decision-provenance shortfall when
+    # enabled ("short N executors (… milli-cpu) in cpu; blocked by …",
+    # provenance/explain.py) — distinct shortfalls are distinct cache
+    # entries, bounded by the encoder's LRU.
     uniform_failure: Optional[Tuple[Sequence[str], str]] = None
 
     def to_dict(self) -> dict:

@@ -24,6 +24,7 @@
 // C ABI via ctypes (k8s_spark_scheduler_tpu/native/fifo.py).
 
 #include <algorithm>
+#include <array>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -612,6 +613,16 @@ std::vector<int32_t> build_cand(const int32_t* driver_rank, int64_t nb) {
   return cand;
 }
 
+// Optional per-step usage capture for the provenance explainer
+// (fifo_explain_queue): how many nodes hosted executors (each loses one
+// executor row — the sparkpods.go:139-146 quirk) and whether the driver
+// row was applied separately.  nullptr (every hot-path caller) costs one
+// pointer test per app — zero observable cost when provenance is off.
+struct StepUsage {
+  int32_t hosting_nodes = 0;
+  int32_t driver_row_applied = 0;
+};
+
 // One tightly/evenly FIFO step: capacity pass + first-rank driver probe
 // + the usage-subtraction quirk.  Mutates the planes on success.
 // Returns the driver index or -1 (infeasible, planes untouched).
@@ -619,7 +630,8 @@ int32_t step_app_plain(int32_t* a0, int32_t* a1, int32_t* a2,
                        const uint8_t* exec_ok, int64_t nb,
                        const std::vector<int32_t>& cand, const int32_t* d,
                        const int32_t* e, int32_t k, int evenly,
-                       QueueScratch& ws, SweepPool* pool) {
+                       QueueScratch& ws, SweepPool* pool,
+                       StepUsage* usage = nullptr) {
   int32_t* cap = ws.cap.data();
   int64_t total =
       cap_pass_sharded(pool, 0, a0, a1, a2, exec_ok, nb, e, k, cap);
@@ -646,6 +658,7 @@ int32_t step_app_plain(int32_t* a0, int32_t* a1, int32_t* a2,
     a2[i] = wrap_sub(a2[i], e[2]);
   };
   bool driver_hosts_exec = false;
+  int32_t hosts = 0;
   if (evenly) {
     // hosting nodes = first k capacity-bearing nodes in node order
     int32_t placed = 0;
@@ -653,6 +666,7 @@ int32_t step_app_plain(int32_t* a0, int32_t* a1, int32_t* a2,
       int32_t c = (i == didx) ? capd : cap[i];
       if (c <= 0) continue;
       ++placed;
+      ++hosts;
       if (i == didx) driver_hosts_exec = true;
       sub_exec(i);
     }
@@ -663,6 +677,7 @@ int32_t step_app_plain(int32_t* a0, int32_t* a1, int32_t* a2,
       int32_t c = (i == didx) ? capd : cap[i];
       if (c <= 0) continue;
       cum += c;
+      ++hosts;
       if (i == didx) driver_hosts_exec = true;
       sub_exec(i);
     }
@@ -672,6 +687,10 @@ int32_t step_app_plain(int32_t* a0, int32_t* a1, int32_t* a2,
     a1[didx] = wrap_sub(a1[didx], d[1]);
     a2[didx] = wrap_sub(a2[didx], d[2]);
   }
+  if (usage != nullptr) {
+    usage->hosting_nodes = hosts;
+    usage->driver_row_applied = driver_hosts_exec ? 0 : 1;
+  }
   return didx;
 }
 
@@ -680,7 +699,7 @@ int32_t step_app_minfrag(int32_t* a0, int32_t* a1, int32_t* a2,
                          const uint8_t* exec_ok, int64_t nb,
                          const std::vector<int32_t>& cand, const int32_t* d,
                          const int32_t* e, int32_t k, QueueScratch& ws,
-                         SweepPool* pool) {
+                         SweepPool* pool, StepUsage* usage = nullptr) {
   int32_t* caps = ws.mf_caps.data();
   // ONE pass yields both the UNCLAMPED min-frag capacities and the
   // tightly feasibility total sum(clamp(c, 0, k))
@@ -733,6 +752,13 @@ int32_t step_app_minfrag(int32_t* a0, int32_t* a1, int32_t* a2,
     a1[didx] = wrap_sub(a1[didx], d[1]);
     a2[didx] = wrap_sub(a2[didx], d[2]);
   }
+  if (usage != nullptr) {
+    // MfSegs nodes are unique across segments, so the segment count IS
+    // the hosting-node count
+    usage->hosting_nodes =
+        placed_any ? static_cast<int32_t>(ws.segs.size()) : 0;
+    usage->driver_row_applied = driver_hosts_exec ? 0 : 1;
+  }
   return didx;
 }
 
@@ -755,6 +781,89 @@ void join_planes(const std::vector<int32_t>& a0, const std::vector<int32_t>& a1,
     rows[i * kDims + 1] = a1[i];
     rows[i * kDims + 2] = a2[i];
   }
+}
+
+// ---------------------------------------------------------------------------
+// Decision-provenance explainer (ops side: provenance/explain.py).
+//
+// A refused driver's verdict is a bare infeasible bit; the explainer
+// recovers the WHY: which dimension is short and by how much (the
+// shortfall vector), which node comes closest to hosting the gang, and
+// which earlier FIFO drivers consumed the capacity this app needed (the
+// blocker set).  Runs only on demand — the hot solve paths never call
+// any of this, and the StepUsage capture they share is nullptr there.
+// ---------------------------------------------------------------------------
+
+// One feasibility probe of an app against fixed planes, with the
+// diagnostic decomposition: full clamped capacity total, per-dim-alone
+// totals (dim j as the only constraint — the argmin is the tightest
+// dimension), the best single node, and the count of driver candidates
+// whose availability covers the driver row.  Feasibility reproduces
+// step_app_plain's rule exactly (min-frag feasibility equals tightly's:
+// the drain is work-conserving), so a probe verdict always matches the
+// solver's verdict at the same planes.
+struct ExplainProbe {
+  int64_t dim_total[kDims] = {0, 0, 0};
+  int64_t cap_total = 0;
+  int32_t max_cap = 0;
+  int32_t max_node = -1;
+  int64_t driver_fit = 0;
+  bool feasible = false;
+};
+
+void explain_probe(const int32_t* a0, const int32_t* a1, const int32_t* a2,
+                   const uint8_t* eok, int64_t nb,
+                   const std::vector<int32_t>& cand, const int32_t* d,
+                   const int32_t* e, int32_t k,
+                   std::vector<int32_t>& cap_ws, ExplainProbe* out) {
+  cap_ws.resize(nb);
+  int32_t* cap = cap_ws.data();
+  const int64_t total = cap_pass_all(a0, a1, a2, eok, nb, e, k, cap);
+  out->cap_total = total;
+  const int32_t* planes[kDims] = {a0, a1, a2};
+  for (int j = 0; j < kDims; ++j) {
+    int64_t tj = 0;
+    const int32_t* a = planes[j];
+    if (e[j] == 0) {
+      // a zero-requirement dim bounds nothing unless overdrawn: per
+      // node it contributes the full clamp k when non-negative
+      for (int64_t i = 0; i < nb; ++i) {
+        if (eok[i] && a[i] >= 0) tj += k;
+      }
+    } else {
+      const int32_t den = std::max(e[j], 1);
+      for (int64_t i = 0; i < nb; ++i) {
+        if (!eok[i] || a[i] <= 0) continue;
+        tj += std::min<int64_t>(a[i] / den, k);
+      }
+    }
+    out->dim_total[j] = tj;
+  }
+  int32_t maxc = 0;
+  int64_t maxi = -1;
+  for (int64_t i = 0; i < nb; ++i) {
+    if (cap[i] > maxc) {
+      maxc = cap[i];
+      maxi = i;
+    }
+  }
+  out->max_cap = maxc;
+  out->max_node = static_cast<int32_t>(maxi);
+  int64_t dfit = 0;
+  bool feas = false;
+  for (int32_t i : cand) {
+    const int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+    if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+    ++dfit;
+    if (!feas && total >= k) {
+      int32_t am[kDims];
+      for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+      const int32_t cwd = eok[i] ? clamped_cap(am, e, k) : 0;
+      if (total - cap[i] + cwd >= k) feas = true;
+    }
+  }
+  out->driver_fit = dfit;
+  out->feasible = feas;
 }
 
 // ---------------------------------------------------------------------------
@@ -1463,6 +1572,137 @@ extern "C" int64_t fifo_sess_mem_bytes(void* handle) {
   for (const auto& c : s->chk1) total += vb(c);
   for (const auto& c : s->chk2) total += vb(c);
   return total;
+}
+
+// Explain one queue position's verdict (provenance/explain.py): replay
+// the queue from the given basis with the policy-correct step function,
+// probing the target app's feasibility along the way, and report
+//
+//   out_info[0]  flip — the queue position whose (feasible) step turned
+//                the target infeasible; -1 = target feasible at its own
+//                position; -2 = infeasible even against the empty basis
+//                (the cluster is undersized, no earlier driver to blame)
+//   out_info[1]  target feasible at its own position (0/1)
+//   out_info[2]  clamped capacity total at the target position
+//   out_info[3..5]  per-dim-alone capacity totals (tightest = argmin)
+//   out_info[6]  best single-node capacity,  out_info[7] its index
+//   out_info[8]  driver candidates whose availability covers the driver
+//   out_info[9]  tightest dimension (-1 = capacity fine, driver-blocked)
+//   out_info[10] shortfall in executor units (k − capacity total)
+//   out_info[11] blocker count
+//   out_blockers [na] u8 — the blocker set: walking back from the flip
+//                position, the feasible earlier drivers whose recorded
+//                consumption in the tightest dimension covers the
+//                resource shortfall (the preemption-vocabulary victim
+//                candidates); the flip driver is always included
+//
+// Feasibility is monotone along the queue (steps only subtract), so
+// probing stops at the first flip.  Cost: ≤ 2 cold solves worth of
+// passes — explain is an on-demand diagnostic, never a hot path.
+int fifo_explain_queue(int64_t nb, int64_t na, const int32_t* avail_rows,
+                       const int32_t* driver_rank, const uint8_t* exec_ok,
+                       const int32_t* apps8, int policy, int64_t target,
+                       uint8_t* out_blockers, int64_t* out_info) {
+  if (nb <= 0 || na <= 0 || target < 0 || target >= na) return 0;
+  std::vector<int32_t> cand = build_cand(driver_rank, nb);
+  std::vector<int32_t> a0, a1, a2;
+  split_planes(avail_rows, nb, a0, a1, a2);
+  QueueScratch ws;
+  ws.cap.resize(nb);
+  ws.mf_caps.resize(nb);
+  std::vector<int32_t> probe_ws;
+  for (int64_t i = 0; i < na; ++i) out_blockers[i] = 0;
+
+  const int32_t* trow = apps8 + target * 8;
+  const int32_t* td = trow;
+  const int32_t* te = trow + 3;
+  const int32_t tk = trow[6];
+
+  ExplainProbe probe;
+  explain_probe(a0.data(), a1.data(), a2.data(), exec_ok, nb, cand, td, te,
+                tk, probe_ws, &probe);
+  int64_t flip = -1;
+  bool still_feasible = probe.feasible;
+  if (!still_feasible) flip = -2;
+
+  std::vector<std::array<int64_t, kDims>> used(
+      target, std::array<int64_t, kDims>{0, 0, 0});
+  std::vector<uint8_t> step_feas(target, 0);
+
+  for (int64_t i = 0; i < target; ++i) {
+    const int32_t* row = apps8 + i * 8;
+    if (!row[7]) continue;
+    StepUsage su;
+    int32_t di;
+    if (policy == 2) {
+      di = step_app_minfrag(a0.data(), a1.data(), a2.data(), exec_ok, nb,
+                            cand, row, row + 3, row[6], ws, nullptr, &su);
+    } else {
+      di = step_app_plain(a0.data(), a1.data(), a2.data(), exec_ok, nb, cand,
+                          row, row + 3, row[6], policy == 1, ws, nullptr,
+                          &su);
+    }
+    if (di < 0) continue;
+    step_feas[i] = 1;
+    for (int j = 0; j < kDims; ++j) {
+      used[i][j] = static_cast<int64_t>(su.hosting_nodes) * row[3 + j] +
+                   (su.driver_row_applied ? static_cast<int64_t>(row[j]) : 0);
+    }
+    if (still_feasible) {
+      ExplainProbe after;
+      explain_probe(a0.data(), a1.data(), a2.data(), exec_ok, nb, cand, td,
+                    te, tk, probe_ws, &after);
+      if (!after.feasible) {
+        still_feasible = false;
+        flip = i;
+      }
+    }
+  }
+
+  // the verdict the operator saw: the target against its own position
+  explain_probe(a0.data(), a1.data(), a2.data(), exec_ok, nb, cand, td, te,
+                tk, probe_ws, &probe);
+
+  int64_t tightest = -1;
+  int64_t shortfall = 0;
+  if (!probe.feasible && probe.cap_total < tk) {
+    for (int j = 0; j < kDims; ++j) {
+      if (te[j] == 0) continue;
+      if (tightest < 0 || probe.dim_total[j] < probe.dim_total[tightest]) {
+        tightest = j;
+      }
+    }
+    shortfall = tk - probe.cap_total;
+  }
+
+  int64_t blocker_count = 0;
+  if (!probe.feasible && flip >= 0) {
+    const int64_t need =
+        (tightest >= 0) ? shortfall * static_cast<int64_t>(te[tightest]) : 0;
+    int64_t freed = 0;
+    for (int64_t i = flip; i >= 0; --i) {
+      if (!step_feas[i]) continue;
+      out_blockers[i] = 1;
+      ++blocker_count;
+      if (tightest < 0) break;  // driver-blocked: the flip driver alone
+      freed += used[i][tightest];
+      if (freed >= need) break;
+    }
+  }
+
+  out_info[0] = flip;
+  out_info[1] = probe.feasible ? 1 : 0;
+  out_info[2] = probe.cap_total;
+  out_info[3] = probe.dim_total[0];
+  out_info[4] = probe.dim_total[1];
+  out_info[5] = probe.dim_total[2];
+  out_info[6] = probe.max_cap;
+  out_info[7] = probe.max_node;
+  out_info[8] = probe.driver_fit;
+  out_info[9] = tightest;
+  out_info[10] = shortfall;
+  out_info[11] = blocker_count;
+  return 1;
 }
 
 // CPython-compatible float64 sum: the packing-efficiency gauge

@@ -86,6 +86,22 @@ def test_bench_final_line_is_the_headline(tmp_path):
             assert headline["warm_solve_p50_ms"] == ds["warm_p50_ms"] > 0
             assert headline["cold_solve_p50_ms"] == ds["cold_p50_ms"] > 0
             assert ds["warm_speedup_p50"] > 0
+
+        # provenance overhead contract (PR 6): when the native explainer
+        # exists the bench must pin explain + flight-recorder costs as
+        # their own lane — explain is an on-demand diagnostic budgeted at
+        # "about a cold solve", the recorder note at sub-millisecond, and
+        # the persisted bundle file is bounded
+        from k8s_spark_scheduler_tpu.native.fifo import (
+            native_explain_available,
+        )
+
+        if native_explain_available():
+            prov = artifact["lanes"].get("provenance-explain cpu")
+            assert prov is not None
+            assert prov["explain_p50_ms"] > 0
+            assert prov["recorder_note_p50_ms"] >= 0
+            assert prov["bundle_file_bytes"] > 0
     else:
         assert headline["metric"].startswith("p99_queue_solve")
         assert lane is None

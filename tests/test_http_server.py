@@ -583,6 +583,93 @@ def test_debug_schedule_endpoint(served_fifo):
     assert status == 404
 
 
+def test_explain_endpoint_acceptance(served_fifo):
+    """ISSUE 6 acceptance: GET /explain/<pod> returns the tightest-
+    dimension shortfall + blocker fields for a refused driver, and the
+    enriched /debug/schedule carries the provenance section."""
+    api, scheduler, http = served_fifo
+    _create_nodes(api)  # 2 nodes × 8 cpu
+    # a gang that cannot fit: 8 executors × 4 cpu
+    pods = Harness.static_allocation_spark_pods(
+        "app-explain", 8, driver_cpu=2, executor_cpu=4,
+        driver_mem="1Gi", executor_mem="1Gi",
+    )
+    driver_json = serde.pod_to_dict(pods[0])
+    api.create(serde.pod_from_dict(driver_json))
+    status, body = _post(
+        http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]}
+    )
+    assert status == 200 and body.get("FailedNodes")
+
+    pod_name = driver_json["metadata"]["name"]
+    status, record = _get(http.port, f"/explain/{pod_name}")
+    assert status == 200
+    assert record["pod"] == pod_name
+    assert record["outcome"] == "failure-fit"
+    from k8s_spark_scheduler_tpu.native.fifo import native_explain_available
+
+    if native_explain_available():
+        sf = record["shortfall"]
+        assert sf["tightestDimension"] == "cpu"
+        assert sf["shortfallExecutors"] >= 1
+        assert "blockedBy" in sf
+        assert "short" in record["summary"]
+        # the wire failure message carries the same actionable detail
+        assert "short" in next(iter(body["FailedNodes"].values()))
+
+    status, _ = _get(http.port, "/explain/no-such-pod")
+    assert status == 404
+
+    # /debug/schedule gains the provenance section
+    status, _, raw = _get_raw(http.port, f"/debug/schedule/{pod_name}")
+    assert status == 200
+    assert "provenance:" in raw.decode()
+
+
+def test_metrics_openmetrics_negotiation(served_fifo):
+    """Satellite: the exemplar-carrying flavour is explicit opt-in
+    (?format=openmetrics); EVERY Accept header keeps getting the plain
+    0.0.4 text a Prometheus parser accepts — including a strict
+    OpenMetrics-only scraper, whose parser would reject our pragmatic
+    exemplar placement and fail the whole scrape."""
+    api, scheduler, http = served_fifo
+    _create_nodes(api)
+    driver_json, _ = _driver_pod_json("app-om", executors=1)
+    api.create(serde.pod_from_dict(driver_json))
+    _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+
+    # explicit opt-in: exemplars + # EOF + openmetrics content type
+    status, headers, raw = _get_raw(http.port, "/metrics?format=openmetrics")
+    assert status == 200
+    assert headers.get("Content-Type").startswith("application/openmetrics-text")
+    text = raw.decode()
+    assert text.rstrip().endswith("# EOF")
+    # the predicate's latency histogram carries its trace exemplar
+    assert "schedule_time_count" in text
+    assert 'trace_id="' in text
+
+    # plain negotiation unchanged: no exemplars, no EOF
+    status, headers, raw = _get_raw(
+        http.port, "/metrics", {"Accept": "text/plain;version=0.0.4"}
+    )
+    assert status == 200 and headers.get("Content-Type").startswith("text/plain")
+    plain = raw.decode()
+    assert "trace_id" not in plain and "# EOF" not in plain
+
+    # Accept headers NEVER negotiate the pragmatic flavour — a stock
+    # dual-accept Prometheus and a strict OpenMetrics-only scraper both
+    # get the plain 0.0.4 text their parsers accept
+    for accept in (
+        "application/openmetrics-text;version=1.0.0;q=0.5,"
+        "text/plain;version=0.0.4;q=0.4",
+        "application/openmetrics-text;version=1.0.0",
+    ):
+        status, headers, raw = _get_raw(http.port, "/metrics", {"Accept": accept})
+        assert status == 200
+        assert headers.get("Content-Type").startswith("text/plain")
+        assert b"# EOF" not in raw and b"trace_id" not in raw
+
+
 def test_traces_limit_param(served_fifo):
     api, scheduler, http = served_fifo
     _create_nodes(api)

@@ -1,0 +1,713 @@
+"""Decision provenance (ISSUE 6): unschedulability explainer, shortfall
+telemetry, and the anomaly flight recorder.
+
+Covers the acceptance criteria end to end: a refused driver's
+``/explain`` carries the tightest-dimension shortfall + blocker set, a
+trigger-persisted bundle replays in the sim to byte-identical verdicts
+across all three native policies and both warm/cold lanes, and the ring
+and bundle sizes stay bounded under a scheduling soak.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from k8s_spark_scheduler_tpu.native.fifo import (
+    explain_queue_native,
+    native_explain_available,
+    native_fifo_available,
+    solve_queue_min_frag_native,
+    solve_queue_native,
+)
+from k8s_spark_scheduler_tpu.provenance.recorder import (
+    FlightRecorder,
+    replay_bundle,
+    replay_bundle_file,
+)
+from k8s_spark_scheduler_tpu.provenance.records import (
+    DecisionRecord,
+    ProvenanceRing,
+)
+from k8s_spark_scheduler_tpu.provenance.tracker import (
+    ProvenanceTracker,
+    SolveArtifacts,
+)
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+pytestmark = pytest.mark.skipif(
+    not native_fifo_available(), reason="native fifo solver unavailable"
+)
+
+needs_explain = pytest.mark.skipif(
+    not native_explain_available(), reason="native explainer unavailable"
+)
+
+
+# ---------------------------------------------------------------------------
+# native explainer units
+# ---------------------------------------------------------------------------
+
+
+def _uniform_cluster(nb=4, cpu=8, mem=16, gpu=0):
+    avail = np.tile(np.array([cpu, mem, gpu], np.int32), (nb, 1))
+    rank = np.arange(nb, dtype=np.int32)
+    eok = np.ones(nb, dtype=bool)
+    return avail, rank, eok
+
+
+def _app(d, e, k, valid=1):
+    return list(d) + list(e) + [k, valid]
+
+
+@needs_explain
+def test_explain_capacity_shortfall_tightest_dimension():
+    # 2 nodes × (cpu 4, mem 100): a gang of 5 × (cpu 2, mem 1) is cpu-
+    # bound — per-dim totals: cpu 2+2=4, mem 100→clamped 5+5=10
+    avail, rank, eok = _uniform_cluster(nb=2, cpu=4, mem=100)
+    apps = np.array([_app((1, 1, 0), (2, 1, 0), 5)], np.int32)
+    res = explain_queue_native(avail, rank, eok, apps, 0, 0)
+    assert not res.feasible
+    assert res.flip == -2  # infeasible even at the basis
+    assert res.tightest_dim == 0  # cpu
+    assert res.dim_totals[0] == 4
+    assert res.dim_totals[1] == 10
+    assert res.cap_total == 4
+    assert res.shortfall_execs == 5 - 4 == 1
+    assert res.max_cap == 2 and res.max_node in (0, 1)
+    assert res.blocker_count == 0
+
+
+@needs_explain
+def test_explain_feasible_target_flags():
+    avail, rank, eok = _uniform_cluster(nb=2, cpu=8, mem=16)
+    apps = np.array([_app((1, 1, 0), (2, 2, 0), 3)], np.int32)
+    res = explain_queue_native(avail, rank, eok, apps, 0, 0)
+    assert res.feasible
+    assert res.flip == -1
+    assert res.shortfall_execs == 0
+    assert res.blocker_count == 0
+
+
+@needs_explain
+def test_explain_blocker_set_walkback():
+    # 2 nodes × cpu 10.  Three earlier 1×(cpu 4) gangs drain the cpu;
+    # the target 2×(cpu 4) gang fits the basis but not position 3.
+    avail, rank, eok = _uniform_cluster(nb=2, cpu=10, mem=1000)
+    earlier = [_app((1, 0, 0), (4, 0, 0), 1) for _ in range(3)]
+    target = _app((1, 0, 0), (4, 0, 0), 2)
+    apps = np.array(earlier + [target], np.int32)
+    res = explain_queue_native(avail, rank, eok, apps, 0, 3)
+    assert not res.feasible
+    assert res.flip >= 0  # became infeasible because of the queue
+    assert res.tightest_dim == 0
+    assert res.blocker_count >= 1
+    # the flip-position driver is always in the blocker set
+    assert bool(res.blockers[res.flip])
+    # blockers are earlier feasible drivers only
+    assert not res.blockers[3:].any()
+
+
+@needs_explain
+@pytest.mark.parametrize("policy", [0, 1, 2])
+def test_explain_runs_under_every_policy(policy):
+    avail, rank, eok = _uniform_cluster(nb=3, cpu=9, mem=30)
+    earlier = [_app((1, 1, 0), (2, 2, 0), 3) for _ in range(3)]
+    target = _app((1, 1, 0), (2, 2, 0), 3)
+    apps = np.array(earlier + [target], np.int32)
+    res = explain_queue_native(avail, rank, eok, apps, policy, len(earlier))
+    assert res is not None
+    # policy-correct replay must agree with the policy's own solver on
+    # the earlier verdicts' effect: the probe's verdict for the target
+    # equals solving the whole queue and reading the target's verdict
+    if policy == 2:
+        feas, _, _ = solve_queue_min_frag_native(
+            avail, rank, eok, apps[:, 0:3], apps[:, 3:6], apps[:, 6],
+            apps[:, 7].astype(bool),
+        )
+    else:
+        feas, _, _ = solve_queue_native(
+            avail, rank, eok, apps[:, 0:3], apps[:, 3:6], apps[:, 6],
+            apps[:, 7].astype(bool), evenly=(policy == 1),
+        )
+    assert bool(res.feasible) == bool(feas[len(earlier)])
+
+
+# ---------------------------------------------------------------------------
+# record ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounded_and_latest_indexed():
+    ring = ProvenanceRing(capacity=4)
+    for i in range(10):
+        ring.record(DecisionRecord(pod=f"pod-{i % 3}", outcome="success"))
+    assert len(ring) == 4
+    stats = ring.stats()
+    assert stats["size"] == 4 and stats["recorded"] == 10
+    # latest wins per pod; the index never outgrows the ring
+    assert ring.latest_for_pod("pod-0") is not None
+    assert stats["indexed_pods"] <= 4
+    # an evicted pod with no newer record is pruned from the index
+    ring2 = ProvenanceRing(capacity=2)
+    ring2.record(DecisionRecord(pod="a"))
+    ring2.record(DecisionRecord(pod="b"))
+    ring2.record(DecisionRecord(pod="c"))
+    assert ring2.latest_for_pod("a") is None
+    assert ring2.latest_for_pod("b") is not None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + replay parity (acceptance: all 3 policies, both lanes)
+# ---------------------------------------------------------------------------
+
+
+def _artifacts_for(policy_code, seed=0):
+    rng = np.random.default_rng(42 + seed + policy_code)
+    nb = 16
+    avail = rng.integers(4, 40, size=(nb, 3)).astype(np.int32)
+    avail[:, 2] = 0  # keep min-frag sentinel-safe and gangs schedulable
+    rank = np.arange(nb, dtype=np.int32)
+    eok = np.ones(nb, dtype=bool)
+    na = 7
+    apps = np.zeros((na, 8), np.int32)
+    apps[:, 0:3] = rng.integers(1, 4, size=(na, 3))
+    apps[:, 3:6] = rng.integers(1, 6, size=(na, 3))
+    apps[:, 2] = 0
+    apps[:, 5] = 0
+    apps[:, 6] = rng.integers(1, 5, size=na)
+    apps[:, 7] = 1
+    n_earlier = na - 1
+    earlier = apps[:n_earlier]
+    if policy_code == 2:
+        feas, didx, after = solve_queue_min_frag_native(
+            avail, rank, eok, earlier[:, 0:3], earlier[:, 3:6],
+            earlier[:, 6], earlier[:, 7].astype(bool),
+        )
+    else:
+        feas, didx, after = solve_queue_native(
+            avail, rank, eok, earlier[:, 0:3], earlier[:, 3:6],
+            earlier[:, 6], earlier[:, 7].astype(bool),
+            evenly=(policy_code == 1),
+        )
+    return SolveArtifacts(
+        policy_code=policy_code,
+        lane="native",
+        basis=avail,
+        driver_rank=rank,
+        exec_ok=eok,
+        packed=apps,
+        n_earlier=n_earlier,
+        feasible=feas,
+        didx=didx,
+        resume=0,
+        avail_after=after,
+        queue_names=tuple(f"drv-{i}" for i in range(n_earlier)),
+    )
+
+
+@pytest.mark.parametrize("policy_code", [0, 1, 2])
+def test_bundle_replays_byte_identical_across_lanes(policy_code, tmp_path):
+    """Acceptance: a persisted bundle replays to byte-identical verdicts
+    on the cold stateless lane AND the warm session lane (fresh solve +
+    full-prefix resume) for every policy."""
+    rec = FlightRecorder(capacity=4, out_dir=str(tmp_path))
+    art = _artifacts_for(policy_code)
+    seq = rec.note(art, f"pod-p{policy_code}", "failure-fit")
+    assert seq is not None
+    path = rec.persist("test-trigger", "unit")
+    assert path is not None and os.path.exists(path)
+    results = replay_bundle_file(path)
+    assert len(results) == 1
+    r = results[0]
+    assert r["ok"], r["mismatches"]
+    assert r["lanes"]["cold"] == "ok"
+    assert r["lanes"].get("warm-first") == "ok"
+    assert r["lanes"].get("warm-resume") == "ok"
+
+
+def test_replay_detects_tampered_verdicts(tmp_path):
+    rec = FlightRecorder(capacity=2, out_dir=str(tmp_path))
+    rec.note(_artifacts_for(0), "pod-t", "success")
+    path = rec.persist("tamper-test")
+    lines = open(path).read().splitlines()
+    bundle = json.loads(lines[1])
+    # flip one recorded verdict: the replay must notice
+    bundle["verdicts"]["feasible"][0] ^= 1
+    res = replay_bundle(bundle)
+    assert not res["ok"]
+    assert any("feasible" in m for m in res["mismatches"])
+
+
+def test_recorder_ring_and_bundles_bounded(tmp_path):
+    rec = FlightRecorder(capacity=3, out_dir=str(tmp_path), max_nodes=64)
+    for i in range(10):
+        rec.note(_artifacts_for(0, seed=i), f"pod-{i}", "success")
+    stats = rec.stats()
+    assert stats["size"] == 3 and stats["noted"] == 10
+    path = rec.persist("bound-test")
+    with open(path) as f:
+        payload_lines = [ln for ln in f if ln.strip()]
+    assert len(payload_lines) == 1 + 3  # header + bounded ring
+    # oversize bases are skipped, not stored
+    big = _artifacts_for(0)
+    big.basis = np.zeros((128, 3), np.int32)
+    assert rec.note(big, "pod-big", "success") is None
+    assert rec.stats()["skipped_oversize"] == 1
+
+
+# ---------------------------------------------------------------------------
+# extender integration (harness)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fifo_harness(tmp_path):
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    tracker = h.server.provenance
+    if tracker is not None:
+        tracker.recorder.out_dir = str(tmp_path / "bundles")
+    yield h
+    h.close()
+
+
+@needs_explain
+def test_refused_driver_explain_has_shortfall_and_message(fifo_harness):
+    h = fifo_harness
+    for i in range(2):
+        h.new_node(f"node-{i}", cpu=8, memory="32Gi", zone="az-a")
+    names = [f"node-{i}" for i in range(2)]
+    pods = h.static_allocation_spark_pods(
+        "app-too-big", 5, driver_cpu=2, executor_cpu=4,
+        driver_mem="2Gi", executor_mem="4Gi",
+    )
+    result = h.schedule(pods[0], names)
+    assert not result.node_names
+    message = next(iter(result.failed_nodes.values()))
+    assert "short" in message and "cpu" in message
+
+    tracker = h.server.provenance
+    record = tracker.explain(pods[0].name)
+    assert record is not None
+    assert record["outcome"] == "failure-fit"
+    sf = record["shortfall"]
+    assert sf["kind"] == "capacity"
+    assert sf["tightestDimension"] == "cpu"
+    assert sf["shortfallExecutors"] >= 1
+    assert sf["nearestFitNode"] in names
+    assert record["feedSeq"] is not None
+    assert record["lane"] in ("native-session", "native", "xla")
+
+
+@needs_explain
+def test_refusal_blocked_by_earlier_driver_names_blockers(fifo_harness):
+    h = fifo_harness
+    for i in range(2):
+        h.new_node(f"node-{i}", cpu=8, memory="32Gi", zone="az-a")
+    names = [f"node-{i}" for i in range(2)]
+    # a pending earlier driver that hogs the cluster when replayed
+    first = h.static_allocation_spark_pods(
+        "app-hog", 2, driver_cpu=2, executor_cpu=5,
+        driver_mem="2Gi", executor_mem="4Gi",
+    )
+    h.create_pod(first[0])
+    import time
+
+    time.sleep(0.02)
+    second = h.static_allocation_spark_pods(
+        "app-victim", 2, driver_cpu=1, executor_cpu=3,
+        driver_mem="1Gi", executor_mem="2Gi",
+    )
+    h.create_pod(second[0])
+    result = h.schedule(second[0], names)
+    assert not result.node_names
+    message = next(iter(result.failed_nodes.values()))
+    assert "blocked by 1 earlier drivers" in message
+    assert "app-hog-driver" in message
+
+    record = h.server.provenance.explain(second[0].name)
+    assert record["shortfall"]["blockedBy"] == ["app-hog-driver"]
+    assert record["queueSlice"] == ["app-hog-driver"]
+    # the decision carried a replayable bundle
+    assert record["bundleSeq"] is not None
+
+
+@needs_explain
+def test_earlier_driver_refusal_explained_without_delta_engine():
+    """Regression: with the delta engine off (Install kill switch) the
+    stateless solve_tensor lane must still capture artifacts BEFORE the
+    blocked-earlier early return, so FAILURE_EARLIER_DRIVER refusals
+    carry shortfall detail too."""
+    from k8s_spark_scheduler_tpu.config import Install
+
+    h = Harness(
+        extra_install=Install(fifo=True, binpack_algo="tpu-batch", delta_solve=False)
+    )
+    try:
+        assert h.server.extender.delta_engine is None
+        for i in range(2):
+            h.new_node(f"node-{i}", cpu=8, memory="32Gi", zone="az-a")
+        names = [f"node-{i}" for i in range(2)]
+        # an enforced earlier driver that cannot fit at all: 3 × 6cpu
+        # executors against 2 × 8cpu nodes (per-node cap 1, total 2 < 3)
+        hog = h.static_allocation_spark_pods(
+            "app-stuck", 3, driver_cpu=1, executor_cpu=6,
+            driver_mem="1Gi", executor_mem="1Gi",
+        )[0]
+        h.create_pod(hog)
+        import time
+
+        time.sleep(0.02)
+        victim = h.static_allocation_spark_pods(
+            "app-after", 1, driver_cpu=1, executor_cpu=1,
+            driver_mem="1Gi", executor_mem="1Gi",
+        )[0]
+        h.create_pod(victim)
+        result = h.schedule(victim, names)
+        assert not result.node_names
+        message = next(iter(result.failed_nodes.values()))
+        assert message.startswith("earlier drivers do not fit")
+        assert "short" in message
+
+        record = h.server.provenance.explain(victim.name)
+        assert record is not None
+        assert record["outcome"] == "failure-earlier-driver"
+        sf = record["shortfall"]
+        assert sf is not None and sf["tightestDimension"] == "cpu"
+        assert record["lane"] in ("native", "native-minfrag", "xla")
+    finally:
+        h.close()
+
+
+def test_uniform_failure_buffer_reuse_with_enriched_message(fifo_harness):
+    """Satellite: the shortfall-enriched message must not break the
+    PR 5 encode-once buffer — identical refusals reuse the same encoded
+    response bytes."""
+    from k8s_spark_scheduler_tpu.types import serde
+
+    h = fifo_harness
+    h.new_node("node-0", cpu=2, memory="4Gi", zone="az-a")
+    names = serde.intern_node_names(["node-0"])
+    pods = h.static_allocation_spark_pods(
+        "app-reuse", 4, driver_cpu=2, executor_cpu=2,
+        driver_mem="2Gi", executor_mem="2Gi",
+    )
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h.create_pod(pods[0])
+    r1 = h.extender.predicate(ExtenderArgs(pod=pods[0], node_names=names))
+    r2 = h.extender.predicate(ExtenderArgs(pod=pods[0], node_names=names))
+    assert r1.uniform_failure is not None and r2.uniform_failure is not None
+    b1 = serde.encode_extender_filter_result(r1)
+    b2 = serde.encode_extender_filter_result(r2)
+    assert b1 is b2  # same (interned candidates, message) → same buffer
+    body = json.loads(b1)
+    msg = next(iter(body["FailedNodes"].values()))
+    if native_explain_available():
+        assert "short" in msg  # the dimension detail reached the wire
+
+
+def test_success_decisions_recorded_too(fifo_harness):
+    h = fifo_harness
+    h.new_node("node-0", cpu=8, memory="32Gi", zone="az-a")
+    pods = h.static_allocation_spark_pods("app-ok", 1)
+    result = h.schedule(pods[0], ["node-0"])
+    assert result.node_names
+    record = h.server.provenance.explain(pods[0].name)
+    assert record is not None
+    assert record["outcome"] == "success"
+    assert record["node"] == "node-0"
+    assert record["shortfall"] is None
+
+
+def test_provenance_soak_stays_bounded(fifo_harness):
+    """Satellite: ring and bundle sizes stay bounded while decisions
+    stream through (the soak assertion shape)."""
+    h = fifo_harness
+    tracker = h.server.provenance
+    for i in range(3):
+        h.new_node(f"node-{i}", cpu=16, memory="64Gi", zone="az-a")
+    names = [f"node-{i}" for i in range(3)]
+    for i in range(40):
+        pods = h.static_allocation_spark_pods(
+            f"app-soak-{i}", 1, driver_cpu=1, executor_cpu=1,
+            driver_mem="1Gi", executor_mem="1Gi",
+        )
+        h.schedule(pods[0], names)
+    stats = tracker.stats()
+    assert stats["ring"]["size"] <= stats["ring"]["capacity"]
+    assert stats["recorder"]["size"] <= stats["recorder"]["capacity"]
+    # bundle ring holds bounded tensor bytes (16-node basis × 8 bundles)
+    assert stats["recorder"]["ring_bytes"] < 4 << 20
+    assert stats["ring"]["recorded"] >= 40
+
+
+# ---------------------------------------------------------------------------
+# triggers
+# ---------------------------------------------------------------------------
+
+
+def test_trigger_persists_bundles(tmp_path):
+    tracker = ProvenanceTracker(bundle_dir=str(tmp_path))
+    tracker.recorder.note(_artifacts_for(0), "pod-x", "failure-fit")
+    path = tracker.on_trigger("deadline-exceeded", "unit test")
+    assert path is not None and os.path.exists(path)
+    header = json.loads(open(path).readline())
+    assert header["trigger"] == "deadline-exceeded"
+    results = replay_bundle_file(path)
+    assert results and all(r["ok"] for r in results)
+
+
+def test_parity_mismatch_fires_recorder(tmp_path):
+    tracker = ProvenanceTracker(bundle_dir=str(tmp_path))
+    tracker.recorder.note(_artifacts_for(1), "pod-y", "success")
+    tracker.on_parity_mismatch({"policy": 1})
+    assert tracker.parity_mismatches == 1
+    assert tracker.recorder.persisted_paths
+
+
+def test_parity_mismatch_bundle_contains_the_diverging_solve(tmp_path):
+    """The persisted warm≠cold bundle must hold the anomalous solve
+    itself (with the recorded-warm verdicts), so replaying it cold
+    reproduces the divergence by construction."""
+    tracker = ProvenanceTracker(bundle_dir=str(tmp_path))
+    bad = _artifacts_for(0)
+    # fabricate a warm divergence: flip one recorded verdict
+    bad.feasible = bad.feasible.copy()
+    bad.feasible[0] = not bad.feasible[0]
+    tracker.on_parity_mismatch({"policy": 0, "artifacts": bad})
+    assert tracker.recorder.persisted_paths
+    results = replay_bundle_file(tracker.recorder.persisted_paths[-1])
+    parity = [r for r in results if r["pod"] == "parity-check"]
+    assert parity, "the diverging solve was not in the bundle"
+    assert not parity[0]["ok"]  # cold replay diverges from warm verdicts
+
+
+@needs_explain
+def test_refusal_explain_memoized_per_content_key(fifo_harness):
+    """A requeue of the same refused pod against unchanged cluster
+    state must serve the explanation from the memo, not re-replay the
+    queue (the refusal-path cost bound)."""
+    from k8s_spark_scheduler_tpu.metrics import names as mnames
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h = fifo_harness
+    h.new_node("node-0", cpu=4, memory="8Gi", zone="az-a")
+    pod = h.static_allocation_spark_pods(
+        "app-memo", 4, driver_cpu=2, executor_cpu=2,
+        driver_mem="2Gi", executor_mem="2Gi",
+    )[0]
+    h.create_pod(pod)
+    metrics = h.server.metrics
+    args = ExtenderArgs(pod=pod, node_names=["node-0"])
+    r1 = h.extender.predicate(args)
+    fresh = metrics.get_counter(
+        mnames.PROVENANCE_EXPLAIN_COUNT, {"source": "refusal"}
+    )
+    r2 = h.extender.predicate(args)
+    assert not r1.node_names and not r2.node_names
+    assert metrics.get_counter(
+        mnames.PROVENANCE_EXPLAIN_COUNT, {"source": "refusal"}
+    ) == fresh  # no second native explain
+    assert metrics.get_counter(
+        mnames.PROVENANCE_EXPLAIN_COUNT, {"source": "refusal-cached"}
+    ) >= 1
+    # both responses carry the same enriched message
+    assert next(iter(r1.failed_nodes.values())) == next(
+        iter(r2.failed_nodes.values())
+    )
+
+
+@needs_explain
+def test_refusal_explain_memo_distinguishes_candidate_subsets(fifo_harness):
+    """kube-scheduler node sampling rotates NodeNames between attempts
+    with no state delta; the memo must treat a different candidate
+    subset as a different explain (the subset lives in the exec_ok /
+    driver_rank masks, not node_names)."""
+    from k8s_spark_scheduler_tpu.metrics import names as mnames
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h = fifo_harness
+    h.new_node("node-0", cpu=8, memory="32Gi", zone="az-a")
+    h.new_node("node-1", cpu=4, memory="32Gi", zone="az-a")
+    pod = h.static_allocation_spark_pods(
+        "app-subset", 9, driver_cpu=2, executor_cpu=4,
+        driver_mem="1Gi", executor_mem="1Gi",
+    )[0]
+    h.create_pod(pod)
+    m = h.server.metrics
+    h.extender.predicate(ExtenderArgs(pod=pod, node_names=["node-0", "node-1"]))
+    h.extender.predicate(ExtenderArgs(pod=pod, node_names=["node-0", "node-1"]))
+    h.extender.predicate(ExtenderArgs(pod=pod, node_names=["node-0"]))
+    assert m.get_counter(
+        mnames.PROVENANCE_EXPLAIN_COUNT, {"source": "refusal"}
+    ) == 2  # full set once, subset once
+    assert m.get_counter(
+        mnames.PROVENANCE_EXPLAIN_COUNT, {"source": "refusal-cached"}
+    ) == 1  # the unchanged repeat
+
+
+@needs_explain
+def test_shortfall_gauges_cleared_on_next_admission(fifo_harness):
+    from k8s_spark_scheduler_tpu.metrics import names as mnames
+
+    h = fifo_harness
+    h.new_node("node-0", cpu=8, memory="32Gi", zone="az-a")
+    metrics = h.server.metrics
+    too_big = h.static_allocation_spark_pods(
+        "app-gauge-big", 6, driver_cpu=2, executor_cpu=4,
+        driver_mem="1Gi", executor_mem="1Gi",
+    )[0]
+    result = h.schedule(too_big, ["node-0"])
+    assert not result.node_names
+    assert metrics.get_gauge(
+        mnames.PROVENANCE_SHORTFALL, {"dim": "cpu"}
+    ) > 0
+    # the refused driver leaves the queue, a fitting gang admits:
+    # the deficit is resolved and the gauge must clear
+    h.delete_pod(too_big)
+    fits = h.static_allocation_spark_pods(
+        "app-gauge-fit", 1, driver_cpu=1, executor_cpu=1,
+        driver_mem="1Gi", executor_mem="1Gi",
+    )[0]
+    assert h.schedule(fits, ["node-0"]).node_names
+    assert metrics.get_gauge(
+        mnames.PROVENANCE_SHORTFALL, {"dim": "cpu"}
+    ) == 0.0
+
+
+def test_trigger_persist_debounced_per_trigger(tmp_path):
+    """An overload-driven trigger storm writes one file per trigger
+    type per interval, never one per failed request."""
+    tracker = ProvenanceTracker(
+        bundle_dir=str(tmp_path), trigger_min_interval=3600.0
+    )
+    tracker.recorder.note(_artifacts_for(0), "pod-d", "failure-deadline")
+    first = tracker.on_trigger("deadline-exceeded", "storm 1")
+    assert first is not None
+    for i in range(5):
+        assert tracker.on_trigger("deadline-exceeded", f"storm {i+2}") is None
+    assert tracker.triggers_suppressed == 5
+    # a DIFFERENT trigger type is not suppressed by the deadline storm
+    assert tracker.on_trigger("breaker-open", "other") is not None
+    assert len(os.listdir(tmp_path)) == 2
+
+
+def test_ring_namespace_disambiguation():
+    ring = ProvenanceRing(capacity=8)
+    ring.record(DecisionRecord(pod="driver-0", namespace="ns-a", outcome="failure-fit"))
+    ring.record(DecisionRecord(pod="driver-0", namespace="ns-b", outcome="success"))
+    assert ring.latest_for_pod("ns-a/driver-0").outcome == "failure-fit"
+    assert ring.latest_for_pod("ns-b/driver-0").outcome == "success"
+    # bare name: newest match across namespaces
+    assert ring.latest_for_pod("driver-0").outcome == "success"
+    assert ring.latest_for_pod("ns-c/driver-0") is None
+
+
+def test_breaker_open_invokes_observer():
+    from k8s_spark_scheduler_tpu.resilience.breaker import CircuitBreaker
+
+    opened = []
+    breaker = CircuitBreaker(failure_threshold=2)
+    breaker.on_open = opened.append
+    breaker.record_failure()
+    assert not opened
+    breaker.record_failure()
+    assert opened == ["writeback"]
+    breaker.record_failure()  # already open: no second fire
+    assert opened == ["writeback"]
+
+
+def test_engine_parity_guard_runs_clean(fifo_harness):
+    """The warm≠cold guard on a healthy engine: warm hits verify
+    against the cold solver and report ok."""
+    h = fifo_harness
+    engine = h.server.extender.delta_engine
+    if engine is None:
+        pytest.skip("delta engine unavailable")
+    calls = {"ok": 0, "bad": 0}
+    engine.parity_interval = 1
+    engine.parity_hooks = (
+        lambda: calls.__setitem__("ok", calls["ok"] + 1),
+        lambda d: calls.__setitem__("bad", calls["bad"] + 1),
+    )
+    h.new_node("node-0", cpu=16, memory="64Gi", zone="az-a")
+    driver = h.static_allocation_spark_pods("app-parity", 1)[0]
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h.create_pod(driver)
+    # first solve cold-builds the session; replays then warm-hit.  The
+    # idempotent-replay shortcut returns before the solver once a
+    # reservation exists, so drive an unschedulable driver instead: it
+    # never gets a reservation, and each retry re-runs the queue solve.
+    big = h.static_allocation_spark_pods(
+        "app-parity-big", 8, driver_cpu=8, executor_cpu=8,
+        driver_mem="32Gi", executor_mem="32Gi",
+    )[0]
+    h.create_pod(big)
+    args = ExtenderArgs(pod=big, node_names=["node-0"])
+    for _ in range(3):
+        h.extender.predicate(args)
+    assert calls["bad"] == 0
+    assert calls["ok"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# sim replay CLI
+# ---------------------------------------------------------------------------
+
+
+def test_sim_replay_bundle_cli(tmp_path, capsys):
+    from k8s_spark_scheduler_tpu.sim.__main__ import main as sim_main
+
+    rec = FlightRecorder(capacity=4, out_dir=str(tmp_path))
+    for policy in (0, 1, 2):
+        rec.note(_artifacts_for(policy), f"pod-{policy}", "failure-fit")
+    path = rec.persist("cli-test")
+    assert sim_main(["--replay-bundle", path]) == 0
+    out = capsys.readouterr().out
+    assert "3 byte-identical, 0 diverged" in out
+
+    # a tampered file must fail the replay
+    lines = open(path).read().splitlines()
+    bundle = json.loads(lines[1])
+    bundle["verdicts"]["didx"][0] += 1
+    tampered = tmp_path / "tampered.jsonl"
+    tampered.write_text(lines[0] + "\n" + json.dumps(bundle) + "\n")
+    assert sim_main(["--replay-bundle", str(tampered)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplars (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_openmetrics_exemplars_negotiated():
+    from k8s_spark_scheduler_tpu.metrics import prometheus as prom
+    from k8s_spark_scheduler_tpu.metrics.registry import MetricsRegistry
+    from k8s_spark_scheduler_tpu.tracing import Tracer
+
+    registry = MetricsRegistry()
+    tracer = Tracer(capacity=8, metrics=registry)
+    with tracer.span("predicate", {"pod": "p"}, trace_id="trace-abc-123"):
+        registry.histogram("foundry.spark.scheduler.schedule.time", 0.0125)
+    registry.histogram("foundry.spark.scheduler.wait.time", 1.0)  # no trace
+
+    plain = prom.render(registry)
+    assert "trace_id" not in plain
+    assert "# EOF" not in plain
+
+    om = prom.render(registry, openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    line = next(
+        ln for ln in om.splitlines()
+        if ln.startswith("foundry_spark_scheduler_schedule_time_count")
+    )
+    assert '# {trace_id="trace-abc-123"} 0.0125' in line
+    # a histogram never observed in-trace carries no exemplar
+    no_ex = next(
+        ln for ln in om.splitlines()
+        if ln.startswith("foundry_spark_scheduler_wait_time_count")
+    )
+    assert "trace_id" not in no_ex

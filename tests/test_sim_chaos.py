@@ -148,6 +148,14 @@ def test_chaos_with_delta_engine_enabled_runs_clean_and_bounded():
     assert result.violations == []
     assert result.summary["invariant_violations"] == 0
     assert result.summary["decisions"] > 0
+    # decision provenance rode along for every decision and stayed
+    # bounded (the ISSUE 6 soak contract at chaos scale)
+    tracker = sim.harness.server.provenance
+    assert tracker is not None
+    pstats = tracker.stats()
+    assert pstats["ring"]["recorded"] >= result.summary["decisions"]
+    assert pstats["ring"]["size"] <= pstats["ring"]["capacity"]
+    assert pstats["recorder"]["size"] <= pstats["recorder"]["capacity"]
     engine = sim.harness.server.extender.delta_engine
     from k8s_spark_scheduler_tpu.native.fifo import native_session_available
 
@@ -189,6 +197,11 @@ def test_chaos_with_delta_engine_runs_clean_under_race_detector(monkeypatch):
     tracked = {name.split("#")[0] for name in detector._instances.values()}
     assert "ChangeFeed" in tracked, tracked
     assert "DeltaSolveEngine" in tracked, tracked
+    # the provenance ring + flight recorder are guarded state on the
+    # decision path now: they must be instrumented and race-free too
+    assert "ProvenanceRing" in tracked, tracked
+    assert "FlightRecorder" in tracked, tracked
+    assert "ProvenanceTracker" in tracked, tracked
     assert detector.races == [], "\n".join(detector.report_lines())
     assert detector.lock_order_violations == [], "\n".join(
         detector.report_lines()
