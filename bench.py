@@ -710,6 +710,7 @@ def cpu_fallback() -> dict:
     native = _native_cpu_measure(problem)
     _deltasolve_measure(problem)
     _provenance_measure(problem)
+    _capacity_probe_measure(problem)
 
     args = _device_args(problem)
 
@@ -1009,6 +1010,60 @@ def _provenance_measure(problem) -> None:
         )
     except Exception as err:
         print(f"# provenance lane unavailable: {err}", file=sys.stderr)
+
+
+def _capacity_probe_measure(problem) -> None:
+    """Capacity-observatory contract (PR 7): the batched what-if
+    headroom probe at the bench node shape × 16 gang shapes, as its own
+    diagnostic lane.  The probe is the sampler's unit of work (one per
+    (group, zone) combo per state change), so its latency budget is
+    'milliseconds at 10k nodes', and the bisection depth (solves per
+    shape) should stay a handful — both are pinned by the bench
+    contract."""
+    try:
+        from k8s_spark_scheduler_tpu.native.fifo import (
+            native_probe_available,
+            probe_headroom_native,
+        )
+
+        if not native_probe_available():
+            return
+        n_shapes = 16
+        take = min(n_shapes, problem.driver.shape[0])
+        shapes = np.zeros((n_shapes, 6), dtype=np.int32)
+        shapes[:take, 0:3] = problem.driver[:take]
+        shapes[:take, 3:6] = problem.executor[:take]
+        if take < n_shapes:  # pad by cycling (smoke shapes have few apps)
+            for i in range(take, n_shapes):
+                shapes[i] = shapes[i % max(take, 1)]
+        reps = max(ROUNDS, 10)
+        probe_ms = []
+        solves = 0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = probe_headroom_native(
+                problem.avail, problem.driver_rank, problem.exec_ok,
+                shapes, 1_000_000,
+            )
+            probe_ms.append((time.perf_counter() - t0) * 1000.0)
+            solves = int(out[2].sum())
+        lat = np.array(probe_ms)
+        stats = _lane_stats(lat, int((out[0] > 0).sum()))
+        stats["probe_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+        stats["shapes"] = n_shapes
+        stats["solves_per_probe"] = solves
+        stats["solves_per_shape_p50"] = round(
+            float(np.percentile(out[2], 50)), 1
+        )
+        LANES["capacity-probe cpu"] = stats
+        SECONDARY["capacity_probe_p50_ms"] = stats["probe_p50_ms"]
+        print(
+            f"# [capacity-probe cpu] probe_p50={stats['probe_p50_ms']}ms "
+            f"({n_shapes} shapes, {solves} feasibility solves/probe)",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        print(f"# capacity-probe lane unavailable: {err}", file=sys.stderr)
 
 
 def _check_load() -> bool:

@@ -1,0 +1,53 @@
+"""Capacity observatory: cluster-state analytics as first-class
+scheduler outputs (Borg/Firmament lineage — fragmentation, headroom,
+pending-work pressure) built on the same exact integer math as the
+solver itself.
+
+- :mod:`.probe` — what-if feasibility probes: the largest admissible
+  gang per resource shape (bisection over the monotone feasibility
+  rule) and a per-dimension fragmentation report.  Native
+  (``fifo_probe_headroom``) when the toolchain is present, an exact
+  numpy twin otherwise.
+- :mod:`.observatory` — the background :class:`CapacitySampler`:
+  triggered by the state layer's ChangeFeed sequence (sample only on
+  state change, debounced), NEVER under the extender lock, producing a
+  bounded queryable timeline (``GET /state/capacity*``), Prometheus
+  gauges, and time-to-admit forecasts for queued drivers.
+
+Everything here is read-only diagnostics: no scheduling decision ever
+consumes an observatory output.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# -- extender-lock flag -------------------------------------------------------
+#
+# The acceptance contract is that the sampler runs ZERO solves while the
+# extender (predicate) lock is held: sampling must never stretch lock
+# hold time, directly or by running inside a decision.  threading.Lock
+# has no owner introspection, so the extender marks lock tenure in a
+# thread-local and the sampler refuses to probe (and counts the
+# violation) when invoked from a lock-holding thread.
+#
+# Defined BEFORE the submodule imports below: observatory.py reads
+# in_predicate_lock from this partially-initialized package.
+
+_tenure = threading.local()
+
+
+def enter_predicate_lock() -> None:
+    _tenure.depth = getattr(_tenure, "depth", 0) + 1
+
+
+def exit_predicate_lock() -> None:
+    _tenure.depth = max(getattr(_tenure, "depth", 0) - 1, 0)
+
+
+def in_predicate_lock() -> bool:
+    return getattr(_tenure, "depth", 0) > 0
+
+
+from .observatory import CapacitySample, CapacitySampler  # noqa: E402,F401
+from .probe import frag_report, probe_headroom  # noqa: E402,F401

@@ -1,0 +1,243 @@
+"""What-if capacity probes: the largest admissible gang per resource
+shape and a per-dimension fragmentation report, against a FIXED
+availability basis.
+
+Feasibility replicates the solver's own rule exactly (``step_app_plain``
+in native/fifo_solver.cpp: clamp-sum capacity total + the driver-row
+probe), which all three queue policies share — distribute-evenly only
+changes placement, and the min-frag drain is work-conserving — so a
+probe verdict always matches the real solver's verdict on the same
+state (tests/test_capacity.py proves it across policies and seeds).
+Feasibility is monotone in the executor count (per node
+``min(c,k)·(k+1) ≥ min(c,k+1)·k``), so the headroom search is a
+bisection: O(log k_max) feasibility evaluations over per-node
+capacities computed once per shape.
+
+Two lanes, identical results on the shared domain:
+
+- native ``fifo_probe_headroom`` / ``fifo_frag_report`` on GCD-scaled
+  int32 rows (the same scaling the solver marshal uses);
+- the numpy twin below, exact on raw int64 base units — the fallback
+  when the toolchain is absent or the basis cannot scale to int32.
+
+Read-only diagnostics: no scheduling decision consumes a probe output.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+_BIG = np.int64(2**62)
+INT32_SAFE = 2**31 - 1
+# headroom search roof: far above any real gang, still int32-safe for
+# the native lane's clamp arithmetic
+DEFAULT_K_MAX = 1_000_000
+
+
+def caps_unclamped(
+    avail: np.ndarray, exec_ok: np.ndarray, executor: np.ndarray
+) -> np.ndarray:
+    """Per-node executor capacity, UNCLAMPED (values ≤ 0 = ineligible):
+    exact floor division per nonzero requirement dimension, a
+    zero-requirement dimension binds only when its availability is
+    overdrawn — capacity.go:36-75 semantics, the mf_cap_one formula."""
+    caps = np.full(avail.shape[0], _BIG, dtype=np.int64)
+    for j in range(3):
+        e = int(executor[j])
+        if e == 0:
+            caps = np.where(avail[:, j] >= 0, caps, np.int64(-1))
+        else:
+            caps = np.minimum(
+                caps, np.floor_divide(avail[:, j], max(e, 1))
+            )
+    return np.where(np.asarray(exec_ok, dtype=bool), caps, np.int64(0))
+
+
+def _feasible(
+    avail: np.ndarray,
+    exec_ok: np.ndarray,
+    cand_mask: np.ndarray,
+    caps: np.ndarray,
+    driver: np.ndarray,
+    executor: np.ndarray,
+    k: int,
+) -> bool:
+    """step_app_plain's admission rule at queue position 0."""
+    if k <= 0:
+        # a zero-executor gang admits iff some candidate covers the
+        # driver row (total ≥ 0 is vacuous)
+        return bool((cand_mask & (avail >= driver).all(axis=1)).any())
+    ck = np.clip(caps, 0, k)
+    total = int(ck.sum())
+    if total < k:
+        return False
+    idx = np.flatnonzero(cand_mask & (avail >= driver).all(axis=1))
+    if not len(idx):
+        return False
+    cwd = np.clip(
+        caps_unclamped(avail[idx] - driver, exec_ok[idx], executor), 0, k
+    )
+    return bool((total - ck[idx] + cwd >= k).any())
+
+
+def probe_headroom_numpy(
+    avail: np.ndarray,        # [N, 3] int64 availability (base units)
+    driver_rank: np.ndarray,  # [N] — rank < INT32_SAFE marks a candidate
+    exec_ok: np.ndarray,      # [N] bool
+    shapes: np.ndarray,       # [S, 6] int64: d0..2 e0..2 (base units)
+    k_max: int = DEFAULT_K_MAX,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(headroom[S], usable[S,3], probes[S]) int64 — the numpy twin of
+    the native ``fifo_probe_headroom``."""
+    avail = np.asarray(avail, dtype=np.int64)
+    exec_ok = np.asarray(exec_ok, dtype=bool)
+    shapes = np.asarray(shapes, dtype=np.int64)
+    cand_mask = np.asarray(driver_rank, dtype=np.int64) < INT32_SAFE
+    ns = shapes.shape[0]
+    headroom = np.zeros(ns, dtype=np.int64)
+    usable = np.zeros((ns, 3), dtype=np.int64)
+    probes = np.zeros(ns, dtype=np.int64)
+    for s in range(ns):
+        d, e = shapes[s, 0:3], shapes[s, 3:6]
+        caps = caps_unclamped(avail, exec_ok, e)
+        total_kmax = int(np.clip(caps, 0, k_max).sum())
+        usable[s] = total_kmax * e
+
+        n_probes = 0
+
+        def feasible(k: int) -> bool:
+            nonlocal n_probes
+            n_probes += 1
+            return _feasible(avail, exec_ok, cand_mask, caps, d, e, k)
+
+        hi = min(int(k_max), total_kmax)
+        h = 0
+        if hi >= 1:
+            if feasible(hi):
+                h = hi
+            elif feasible(1):
+                lo = 1
+                while hi - lo > 1:
+                    mid = lo + (hi - lo) // 2
+                    if feasible(mid):
+                        lo = mid
+                    else:
+                        hi = mid
+                h = lo
+        headroom[s] = h
+        probes[s] = n_probes
+    return headroom, usable, probes
+
+
+def probe_headroom(
+    avail: np.ndarray,
+    driver_rank: np.ndarray,
+    exec_ok: np.ndarray,
+    shapes: np.ndarray,
+    k_max: int = DEFAULT_K_MAX,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, str]:
+    """(headroom[S], usable[S,3] in BASE units, probes[S], lane) — the
+    native lane on GCD-scaled int32 rows when it applies, the exact
+    numpy twin otherwise.  Both lanes return identical headrooms
+    (capacities are exact integer quotients, so decisions are
+    scale-invariant)."""
+    avail = np.ascontiguousarray(avail, dtype=np.int64)
+    shapes = np.ascontiguousarray(shapes, dtype=np.int64).reshape(-1, 6)
+    n, ns = avail.shape[0], shapes.shape[0]
+    if n == 0 or ns == 0:
+        return (
+            np.zeros(ns, dtype=np.int64),
+            np.zeros((ns, 3), dtype=np.int64),
+            np.zeros(ns, dtype=np.int64),
+            "empty",
+        )
+    try:
+        from ..native import scale_rows_int32
+        from ..native.fifo import native_probe_available, probe_headroom_native
+
+        if native_probe_available():
+            demand_rows = shapes.reshape(-1, 3)  # [2S, 3] d/e interleaved
+            ok, avail_s, demands_s, scale = scale_rows_int32(
+                avail, demand_rows, n
+            )
+            if ok:
+                rank32 = np.where(
+                    np.asarray(driver_rank, dtype=np.int64) < INT32_SAFE,
+                    np.arange(n, dtype=np.int64),
+                    np.int64(INT32_SAFE),
+                ).astype(np.int32)
+                out = probe_headroom_native(
+                    avail_s[:n],
+                    rank32,
+                    np.asarray(exec_ok, dtype=bool),
+                    demands_s.reshape(ns, 6),
+                    min(int(k_max), INT32_SAFE),
+                )
+                if out is not None:
+                    headroom, usable_scaled, probes = out
+                    return headroom, usable_scaled * scale[None, :], probes, "native"
+    except Exception:  # toolchain/scaling problems degrade to numpy
+        pass
+    headroom, usable, probes = probe_headroom_numpy(
+        avail, driver_rank, exec_ok, shapes, k_max
+    )
+    return headroom, usable, probes, "numpy"
+
+
+def _frag_index(total: np.ndarray, largest: np.ndarray) -> np.ndarray:
+    """Shared final step of both lanes — computed from the SAME base
+    units, so native and numpy are bit-identical."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        frag = np.where(total > 0, 1.0 - largest / np.maximum(total, 1), 0.0)
+    return frag.astype(float)
+
+
+def frag_report(
+    avail: np.ndarray, exec_ok: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(total_free[3], largest_chunk[3], free_nodes[3], overdrawn[3],
+    frag_index[3]) over the eligible rows, in base units.  frag_index =
+    1 − largest/total per dimension (0 when nothing is free): 0 = all
+    free capacity sits on one node (schedulable as one chunk), → 1 =
+    free capacity is dust spread across many nodes.
+
+    One native sweep (``fifo_frag_report`` on GCD-scaled int32 rows,
+    totals unscaled back to base units) when the rows scale exactly,
+    the numpy twin otherwise — positive sums, maxima, and sign counts
+    are all scale-equivariant, so the lanes agree exactly."""
+    avail = np.ascontiguousarray(avail, dtype=np.int64)
+    mask = np.asarray(exec_ok, dtype=bool)
+    if avail.shape[0] == 0 or not mask.any():
+        z = np.zeros(3, dtype=np.int64)
+        return z, z.copy(), z.copy(), z.copy(), np.zeros(3, dtype=float)
+    try:
+        from ..native import scale_rows_int32
+        from ..native.fifo import frag_report_native
+
+        n = avail.shape[0]
+        ok, avail_s, _, scale = scale_rows_int32(
+            avail, np.zeros((0, 3), dtype=np.int64), n
+        )
+        if ok:
+            out = frag_report_native(avail_s[:n], mask)
+            if out is not None:
+                total = out[:, 0] * scale
+                largest = out[:, 1] * scale
+                return (
+                    total,
+                    largest,
+                    out[:, 2].copy(),
+                    out[:, 3].copy(),
+                    _frag_index(total, largest),
+                )
+    except Exception:  # toolchain/scaling problems degrade to numpy
+        pass
+    rows = avail[mask]
+    pos = np.maximum(rows, 0)
+    total = pos.sum(axis=0)
+    largest = pos.max(axis=0)
+    free_nodes = (rows > 0).sum(axis=0).astype(np.int64)
+    overdrawn = (rows < 0).sum(axis=0).astype(np.int64)
+    return total, largest, free_nodes, overdrawn, _frag_index(total, largest)

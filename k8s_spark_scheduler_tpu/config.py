@@ -114,6 +114,39 @@ class ProvenanceConfig:
 
 
 @dataclass
+class CapacityConfig:
+    """Capacity observatory (capacity/): fragmentation/headroom
+    analytics, queue-pressure forecasts, and the ``/state/capacity``
+    timeline.  Diagnostic only — no scheduling decision consumes an
+    observatory output.
+
+    Sampling is change-triggered (the state layer's ChangeFeed wakes
+    the sampler thread, debounced) with ``interval_seconds`` as the
+    idle-heartbeat fallback.  Cardinality caps bound both the probe
+    cost and the label sets the headroom gauge can emit."""
+
+    enabled: bool = True
+    ring_size: int = 256
+    debounce_seconds: float = 0.25
+    interval_seconds: float = 15.0
+    max_shapes: int = 16
+    max_group_zones: int = 16
+    max_queue: int = 64
+
+    @staticmethod
+    def from_dict(d: dict) -> "CapacityConfig":
+        return CapacityConfig(
+            enabled=d.get("enabled", True),
+            ring_size=d.get("ring-size", 256),
+            debounce_seconds=d.get("debounce-seconds", 0.25),
+            interval_seconds=d.get("interval-seconds", 15.0),
+            max_shapes=d.get("max-shapes", 16),
+            max_group_zones=d.get("max-group-zones", 16),
+            max_queue=d.get("max-queue", 64),
+        )
+
+
+@dataclass
 class ConversionWebhookConfig:
     """Where the apiserver reaches the CRD conversion webhook (the
     reference wires this from the witchcraft server's service identity,
@@ -157,6 +190,9 @@ class Install:
     # decision provenance: explainer + shortfall telemetry + flight
     # recorder (provenance/) — diagnostic only, decisions unchanged
     provenance: ProvenanceConfig = field(default_factory=ProvenanceConfig)
+    # capacity observatory: fragmentation/headroom analytics and the
+    # /state/capacity timeline (capacity/) — diagnostic only
+    capacity: CapacityConfig = field(default_factory=CapacityConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -229,4 +265,5 @@ class Install:
             delta_solve=d.get("delta-solve", True),
             resilience=ResilienceConfig.from_dict(d.get("resilience", {})),
             provenance=ProvenanceConfig.from_dict(d.get("provenance", {})),
+            capacity=CapacityConfig.from_dict(d.get("capacity", {})),
         )

@@ -23,6 +23,8 @@ APPLICATION_ZONES_COUNT = "foundry.spark.scheduler.application.zones.count"
 CLIENT_REQUEST_LATENCY = "foundry.spark.scheduler.client.request.latency"
 CLIENT_REQUEST_RESULT = "foundry.spark.scheduler.client.request.result"
 CACHED_OBJECT_COUNT = "foundry.spark.scheduler.cache.objects.count"
+# cache-vs-API-server divergence (reporters.report_cache_drift)
+CACHED_OBJECT_DRIFT = "foundry.spark.scheduler.cache.objects.count.drift"
 INFLIGHT_REQUEST_COUNT = "foundry.spark.scheduler.cache.inflight.count"
 UNBOUND_CPU_RESERVATIONS = "foundry.spark.scheduler.reservations.unbound.cpu"
 UNBOUND_MEMORY_RESERVATIONS = "foundry.spark.scheduler.reservations.unbound.memory"
@@ -37,6 +39,7 @@ EXECUTORS_WITH_NO_RESERVATION_COUNT = (
 )
 SOFT_RESERVATION_COMPACTION_TIME = "foundry.spark.scheduler.softreservation.compaction.time"
 POD_INFORMER_DELAY = "foundry.spark.scheduler.informer.delay"
+POD_INFORMER_DELAY_MAX = "foundry.spark.scheduler.informer.delay.max"
 SCHEDULING_WASTE = "foundry.spark.scheduler.scheduling.waste"
 SCHEDULING_WASTE_PER_INSTANCE_GROUP = (
     "foundry.spark.scheduler.scheduling.wasteperinstancegroup"
@@ -117,6 +120,54 @@ PROVENANCE_BUNDLE_BYTES = (
 # warm≠cold parity guard outcomes, tagged result=ok|mismatch
 PROVENANCE_PARITY_CHECKS = (
     "foundry.spark.scheduler.tpu.provenance.parity.check.count"
+)
+
+# extender-emitted placement / lane diagnostics (previously inline
+# literals in scheduler/extender.py; declared here so the catalog drift
+# check in tests/test_metric_names.py covers them)
+TPU_FASTPATH = "foundry.spark.scheduler.tpu.fastpath"
+SINGLEAZ_LANE = "foundry.spark.scheduler.tpu.singleaz.lane"
+PACKING_EFFICIENCY_MAX = "foundry.spark.scheduler.packing.efficiency.max"
+DRIVER_EXECUTOR_COLLOCATION = "foundry.spark.scheduler.driver.executor.collocation"
+EXECUTOR_NODE_COUNT = "foundry.spark.scheduler.executor.node.count"
+APP_CROSS_ZONE = "foundry.spark.scheduler.app.cross.zone"
+# zone-tagged single-AZ DA pack-failure counter the reschedule path
+# emits (distinct wire name from the reference's untagged
+# SINGLE_AZ_DA_PACK_FAILURE_COUNT; both are pinned)
+SINGLE_AZ_DA_PACK_FAILURE_ZONED = (
+    "foundry.spark.scheduler.single.az.dynamic.allocation.pack.failure"
+)
+
+# capacity observatory (capacity/): native fragmentation/headroom
+# analytics, queue-pressure forecasts, and the /state/capacity timeline
+# per-dim total free capacity over schedulable nodes (base units)
+CAPACITY_FREE = "foundry.spark.scheduler.tpu.capacity.free"
+# per-dim largest single-node free chunk (base units)
+CAPACITY_LARGEST_CHUNK = "foundry.spark.scheduler.tpu.capacity.largest.chunk"
+# per-dim fragmentation index: 1 − largest-chunk/total-free
+CAPACITY_FRAGMENTATION = "foundry.spark.scheduler.tpu.capacity.fragmentation"
+# largest admissible gang per (shape, instance-group, zone); empty
+# group/zone tags = cluster-wide
+CAPACITY_HEADROOM = "foundry.spark.scheduler.tpu.capacity.headroom"
+# per-instance-group max-dimension reserved/allocatable ratio
+CAPACITY_UTILIZATION = "foundry.spark.scheduler.tpu.capacity.utilization"
+# pending driver gangs / the subset that does not fit right now
+CAPACITY_QUEUED_GANGS = "foundry.spark.scheduler.tpu.capacity.queued.gangs"
+CAPACITY_QUEUE_PRESSURE = (
+    "foundry.spark.scheduler.tpu.capacity.queue.pressure"
+)
+# forecast seconds until a fitting queued gang admits
+CAPACITY_TIME_TO_ADMIT = "foundry.spark.scheduler.tpu.capacity.time.to.admit"
+# sampler self-observability
+CAPACITY_SAMPLE_COUNT = "foundry.spark.scheduler.tpu.capacity.sample.count"
+CAPACITY_SAMPLE_TIME = "foundry.spark.scheduler.tpu.capacity.sample.time"
+CAPACITY_PROBE_SOLVES = "foundry.spark.scheduler.tpu.capacity.probe.solves"
+
+# metrics-registry self-observability: per-metric label-set cardinality
+# (tagged metric=<catalog name>) — catches label explosions before
+# Prometheus does
+METRICS_REGISTRY_SERIES = (
+    "foundry.spark.scheduler.tpu.metrics.registry.series"
 )
 
 # tag keys (metrics.go:70-85)

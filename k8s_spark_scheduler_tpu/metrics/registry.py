@@ -143,6 +143,35 @@ class MetricsRegistry:
             h = self._histograms.get((name, _tags(tags)))
             return h.snapshot() if h else Histogram().snapshot()
 
+    def prune_gauges(self, name: str, keep: "set | None" = None) -> int:
+        """Drop every gauge series under ``name`` whose tag dict is not
+        in ``keep`` (an iterable of tag dicts; None = drop all).  For
+        emitters whose label sets track external state — e.g. the
+        capacity observatory's per-(shape, group, zone) headroom — so a
+        vanished label combination stops exporting its last stale value
+        and live cardinality stays bounded by the emitter's own caps."""
+        keep_keys = {_tags(t) for t in keep} if keep is not None else set()
+        with self._lock:
+            dead = [
+                k
+                for k in self._gauges
+                if k[0] == name and k[1] not in keep_keys
+            ]
+            for k in dead:
+                del self._gauges[k]
+            return len(dead)
+
+    def series_stats(self) -> Dict[str, int]:
+        """Per-metric-name label-set cardinality across counters,
+        gauges, and histograms — the registry's own label-explosion
+        canary (reported as …tpu.metrics.registry.series)."""
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for store in (self._counters, self._gauges, self._histograms):
+                for name, _tags_key in store:
+                    counts[name] = counts.get(name, 0) + 1
+            return counts
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

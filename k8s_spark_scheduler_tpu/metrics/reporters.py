@@ -90,6 +90,7 @@ class ReporterSet:
             self.report_informer_delay,
             self.report_jit_cache_sizes,
             self.report_resilience,
+            self.report_registry_series,
         ):
             try:
                 fn()
@@ -150,7 +151,7 @@ class ReporterSet:
         }
         self.metrics.gauge(names.CACHED_OBJECT_COUNT, float(len(cached)))
         drift = len(cached.symmetric_difference(stored))
-        self.metrics.gauge(names.CACHED_OBJECT_COUNT + ".drift", float(drift))
+        self.metrics.gauge(names.CACHED_OBJECT_DRIFT, float(drift))
 
     # -- resourcereservations.go (unbound totals) ---------------------------
 
@@ -205,7 +206,7 @@ class ReporterSet:
         if delays:
             delays.sort()
             self.metrics.gauge(names.POD_INFORMER_DELAY, _percentile(delays, 0.5))
-            self.metrics.gauge(names.POD_INFORMER_DELAY + ".max", delays[-1])
+            self.metrics.gauge(names.POD_INFORMER_DELAY_MAX, delays[-1])
 
     # -- queue depths -------------------------------------------------------
 
@@ -240,6 +241,28 @@ class ReporterSet:
             self.metrics.gauge(
                 names.KERNEL_JIT_CACHE_SIZE, float(size), {names.TAG_KERNEL: kernel}
             )
+
+    # -- registry self-observability -----------------------------------------
+
+    def report_registry_series(self) -> None:
+        """Per-metric label-set cardinality (…tpu.metrics.registry.
+        series, tagged metric=): the canary that catches a label
+        explosion — e.g. a high-cardinality capacity tag — before the
+        Prometheus scrape does.  One series per catalog name, so the
+        canary itself stays O(#metric names)."""
+        published = []
+        for name, series in self.metrics.series_stats().items():
+            if name == names.METRICS_REGISTRY_SERIES:
+                continue  # never self-count: the gauge would ratchet
+            tags = {"metric": name}
+            published.append(tags)
+            self.metrics.gauge(
+                names.METRICS_REGISTRY_SERIES, float(series), tags
+            )
+        # a metric name that vanished from the registry (e.g. pruned
+        # capacity gauges) must not keep exporting its last, too-high
+        # series count — the canary tracks the registry, not history
+        self.metrics.prune_gauges(names.METRICS_REGISTRY_SERIES, published)
 
     # -- resilience ----------------------------------------------------------
 

@@ -191,6 +191,21 @@ class WasteMetricsReporter:
             info = self._info[(namespace, pod_name)] = _PodSchedulingInfo()
         return info
 
+    def scheduling_info(self, namespace: str, pod_name: str):
+        """Read-only view of a pod's demand phase boundaries for the
+        capacity observatory's time-to-admit forecast (None when the
+        reporter has never seen the pod)."""
+        with self._lock:
+            info = self._info.get((namespace, pod_name))
+            if info is None:
+                return None
+            return {
+                "createdAt": info.created_at,
+                "demandCreatedAt": info.demand_created_at,
+                "demandFulfilledAt": info.demand_fulfilled_at,
+                "lastFailureOutcome": info.last_failure_outcome or None,
+            }
+
     def cleanup_metric_cache(self) -> None:
         """waste.go:160-172: drop entries older than 6h."""
         cutoff = timesource.now() - DEMAND_FULFILLED_AGE_CLEANUP_SECONDS

@@ -115,6 +115,18 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ]
             except AttributeError:
                 pass
+            try:
+                # capacity-observatory probes (PR 7) — optional for the
+                # same prebuilt-library reason
+                lib.fifo_probe_headroom.restype = ctypes.c_int
+                lib.fifo_probe_headroom.argtypes = [
+                    ctypes.c_int64, _P, _P, _P, ctypes.c_int64, _P,
+                    ctypes.c_int32, _P, _P, _P,
+                ]
+                lib.fifo_frag_report.restype = ctypes.c_int
+                lib.fifo_frag_report.argtypes = [ctypes.c_int64, _P, _P, _P]
+            except AttributeError:
+                pass
             _lib = lib
         except Exception:
             logger.warning(
@@ -468,6 +480,63 @@ def explain_queue_native(
     if not ok:
         return None
     return ExplainResult(info, blockers.astype(bool))
+
+
+def native_probe_available() -> bool:
+    lib = _build_and_load()
+    return lib is not None and hasattr(lib, "fifo_probe_headroom")
+
+
+def probe_headroom_native(
+    avail: np.ndarray,        # [N, 3] int32 scaled availability basis
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    shapes: np.ndarray,       # [S, 6] int32: d0..2 e0..2 (scaled units)
+    k_max: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """(headroom[S] int64, usable[S,3] int64, probes[S] int64) — per
+    shape, the largest gang size the solver would admit at queue
+    position 0 against this basis (fifo_probe_headroom), or None when
+    the library (or symbol) is unavailable.  Read-only diagnostic —
+    never a decision input."""
+    lib = _build_and_load()
+    if lib is None or not hasattr(lib, "fifo_probe_headroom"):
+        return None
+    av = np.ascontiguousarray(avail, dtype=np.int32)
+    rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    shp = np.ascontiguousarray(shapes, dtype=np.int32)
+    nb, ns = av.shape[0], shp.shape[0]
+    if nb <= 0 or ns <= 0 or k_max <= 0:
+        return None
+    headroom = np.zeros(ns, dtype=np.int64)
+    usable = np.zeros((ns, 3), dtype=np.int64)
+    probes = np.zeros(ns, dtype=np.int64)
+    ok = lib.fifo_probe_headroom(
+        nb, _c(av), _c(rank), _c(eok), ns, _c(shp),
+        ctypes.c_int32(int(k_max)), _c(headroom), _c(usable), _c(probes),
+    )
+    if not ok:
+        return None
+    return headroom, usable, probes
+
+
+def frag_report_native(
+    avail: np.ndarray,   # [N, 3] int32 scaled availability
+    exec_ok: np.ndarray, # [N] bool
+) -> Optional[np.ndarray]:
+    """[3, 4] int64 per-dimension (total free, largest chunk, free
+    nodes, overdrawn nodes) over the eligible rows, or None when the
+    library (or symbol) is unavailable."""
+    lib = _build_and_load()
+    if lib is None or not hasattr(lib, "fifo_frag_report"):
+        return None
+    av = np.ascontiguousarray(avail, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    out = np.zeros(12, dtype=np.int64)
+    if not lib.fifo_frag_report(av.shape[0], _c(av), _c(eok), _c(out)):
+        return None
+    return out.reshape(3, 4)
 
 
 def solve_app_native(

@@ -20,11 +20,13 @@ import numpy as np
 
 from .. import compat
 from .. import timesource
+from ..capacity import enter_predicate_lock, exit_predicate_lock
 from ..config import FifoConfig
 from ..tracing import spans as tracing
 from ..demands.manager import DemandManager
 from ..events import events as ev
 from ..kube.informer import Informer
+from ..metrics import names as mnames
 from ..metrics.registry import MetricsRegistry, default_registry
 from ..ops import capacity as cap
 from ..ops.efficiency import compute_avg_packing_efficiency
@@ -172,27 +174,36 @@ class SparkSchedulerExtender:
     def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
         """resource.go:128-183."""
         with self._predicate_lock:
-            # one span per scheduling decision; role/instanceGroup/
-            # outcome/node tags land via add_tag as they are computed.
-            # Becomes the trace root when called outside the HTTP layer.
-            with self._tracer.span(
-                "predicate",
-                {"pod": args.pod.name, "namespace": args.pod.namespace},
-            ):
-                # the request may have queued behind slow decisions for
-                # its whole deadline; answer fail-fast rather than spend
-                # the lock on a caller that already hung up
-                try:
-                    self._check_deadline("lock-acquired")
-                except SchedulingFailure as err:
-                    tracing.add_tag("outcome", err.outcome)
-                    if self._provenance is not None and self._provenance.enabled:
-                        self._provenance.on_trigger(
-                            "deadline-exceeded",
-                            f"{args.pod.namespace}/{args.pod.name} at lock-acquired",
-                        )
-                    return self._fail_with_message(err.outcome, args, str(err))
-                return self._predicate_locked(args)
+            # mark lock tenure in the thread-local the capacity sampler
+            # checks: a probe invoked from inside a decision would
+            # stretch lock hold time, so the sampler refuses it
+            enter_predicate_lock()
+            try:
+                # one span per scheduling decision; role/instanceGroup/
+                # outcome/node tags land via add_tag as they are
+                # computed.  Becomes the trace root when called outside
+                # the HTTP layer.
+                with self._tracer.span(
+                    "predicate",
+                    {"pod": args.pod.name, "namespace": args.pod.namespace},
+                ):
+                    # the request may have queued behind slow decisions
+                    # for its whole deadline; answer fail-fast rather
+                    # than spend the lock on a caller that already hung
+                    # up
+                    try:
+                        self._check_deadline("lock-acquired")
+                    except SchedulingFailure as err:
+                        tracing.add_tag("outcome", err.outcome)
+                        if self._provenance is not None and self._provenance.enabled:
+                            self._provenance.on_trigger(
+                                "deadline-exceeded",
+                                f"{args.pod.namespace}/{args.pod.name} at lock-acquired",
+                            )
+                        return self._fail_with_message(err.outcome, args, str(err))
+                    return self._predicate_locked(args)
+            finally:
+                exit_predicate_lock()
 
     def _lane_neutral(self, lane: str):
         """A device lane declined the request (unsupported shape, inexact
@@ -436,7 +447,7 @@ class SparkSchedulerExtender:
             instance_group, driver, node_names, app_resources_early
         )
         self._metrics.counter(
-            "foundry.spark.scheduler.tpu.fastpath",
+            mnames.TPU_FASTPATH,
             {"path": "driver", "lane": "fast" if fast is not None else "slow"},
         )
         if fast is not None:
@@ -558,7 +569,7 @@ class SparkSchedulerExtender:
         else:
             max_avg = efficiency.max
         self._metrics.gauge(
-            "foundry.spark.scheduler.packing.efficiency.max",
+            mnames.PACKING_EFFICIENCY_MAX,
             max_avg,
             {"instanceGroup": instance_group, "binpacker": self.binpacker.name},
         )
@@ -748,7 +759,7 @@ class SparkSchedulerExtender:
                 # (exact fallback) — the ops signal for how often the
                 # certified fixed-point zone choice holds
                 self._metrics.counter(
-                    "foundry.spark.scheduler.tpu.singleaz.lane", {"lane": lane}
+                    mnames.SINGLEAZ_LANE, {"lane": lane}
                 )
             if self._lane_health is not None:
                 self._lane_health.record_success(
@@ -939,7 +950,7 @@ class SparkSchedulerExtender:
             single_az_zone if should_schedule_into_single_az else None,
         )
         self._metrics.counter(
-            "foundry.spark.scheduler.tpu.fastpath",
+            mnames.TPU_FASTPATH,
             {"path": "executor", "lane": "fast" if fast is not None else "slow"},
         )
         if fast is not None:
@@ -1009,7 +1020,7 @@ class SparkSchedulerExtender:
         (resource.go:664-672): demand creation + failure."""
         if into_single_az:
             self._metrics.counter(
-                "foundry.spark.scheduler.single.az.dynamic.allocation.pack.failure",
+                mnames.SINGLE_AZ_DA_PACK_FAILURE_ZONED,
                 {"zone": zone},
             )
             self._demands.create_demand_for_executor_in_specific_zone(
@@ -1240,18 +1251,18 @@ class SparkSchedulerExtender:
     def _report_placement_metrics(self, instance_group, packing_result, zones) -> None:
         executor_nodes = set(packing_result.executor_nodes)
         self._metrics.gauge(
-            "foundry.spark.scheduler.driver.executor.collocation",
+            mnames.DRIVER_EXECUTOR_COLLOCATION,
             1.0 if packing_result.driver_node in executor_nodes else 0.0,
             {"instanceGroup": instance_group},
         )
         self._metrics.gauge(
-            "foundry.spark.scheduler.executor.node.count",
+            mnames.EXECUTOR_NODE_COUNT,
             float(len(executor_nodes)),
             {"instanceGroup": instance_group},
         )
         used_zones = {zones.get(n, "") for n in executor_nodes | {packing_result.driver_node}}
         self._metrics.gauge(
-            "foundry.spark.scheduler.app.cross.zone",
+            mnames.APP_CROSS_ZONE,
             1.0 if len(used_zones) > 1 else 0.0,
             {"instanceGroup": instance_group},
         )

@@ -204,6 +204,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_debug_schedule(unquote(path[len("/debug/schedule/"):]))
         elif path.startswith("/explain/") and self.scheduler is not None:
             self._handle_explain(unquote(path[len("/explain/"):]))
+        elif path.startswith("/state/capacity") and self.scheduler is not None:
+            self._handle_capacity(path, query)
         else:
             self._send_json(404, {"error": "not found"})
 
@@ -258,6 +260,92 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         self._send_json(200, record)
+
+    def _handle_capacity(self, path: str, query) -> None:
+        """Capacity observatory (capacity/observatory.py):
+
+        - ``GET /state/capacity`` — the latest cluster-state sample
+          (sampled on demand when the feed moved since the last one).
+          ``?group=`` / ``?zone=`` filter the per-group entries,
+          ``?ns=`` filters the queued-driver forecasts.
+        - ``GET /state/capacity/history?limit=N`` — the timeline ring,
+          newest first.
+        - ``GET /state/capacity/diff?from=&to=`` — what changed between
+          two timeline sequences (exact keys; history lists them)."""
+        sampler = getattr(self.scheduler, "capacity", None)
+        if sampler is None:
+            self._send_json(404, {"error": "capacity observatory not enabled"})
+            return
+
+        def q1(key):
+            vals = query.get(key)
+            return vals[0] if vals else None
+
+        if path == "/state/capacity":
+            # serve fresh state without waiting for the background
+            # debounce: O(1) when the feed hasn't moved
+            sampler.maybe_sample(trigger="http")
+            latest = sampler.latest()
+            if latest is None:
+                self._send_json(
+                    200, {"samples": 0, "capacity": None}
+                )
+                return
+            out = latest.to_dict()
+            group, zone, ns = q1("group"), q1("zone"), q1("ns")
+            if group is not None or zone is not None:
+                out["groups"] = {
+                    combo: entry
+                    for combo, entry in out["groups"].items()
+                    if (group is None or combo.split("|")[0] == group)
+                    and (zone is None or combo.split("|", 1)[1] == zone)
+                }
+                if group is not None:
+                    out["tenants"] = {
+                        g: t for g, t in out["tenants"].items() if g == group
+                    }
+            if ns is not None:
+                out["queue"] = [
+                    e for e in out["queue"] if e.get("namespace") == ns
+                ]
+            self._send_json(200, out)
+        elif path == "/state/capacity/history":
+            limit = None
+            try:
+                limit = int(q1("limit") or "")
+            except ValueError:
+                pass
+            history = sampler.history(limit=limit)
+            self._send_json(
+                200,
+                {
+                    "samples": [s.to_dict() for s in history],
+                    "ring": sampler.stats()["ring"],
+                    "ringCapacity": sampler.stats()["ring_capacity"],
+                },
+            )
+        elif path == "/state/capacity/diff":
+            try:
+                from_seq = int(q1("from") or "")
+                to_seq = int(q1("to") or "")
+            except ValueError:
+                self._send_json(
+                    400, {"error": "usage: /state/capacity/diff?from=<seq>&to=<seq>"}
+                )
+                return
+            diff = sampler.diff(from_seq, to_seq)
+            if diff is None:
+                self._send_json(
+                    404,
+                    {
+                        "error": "sequence not in the timeline ring",
+                        "available": [s.seq for s in sampler.history()],
+                    },
+                )
+                return
+            self._send_json(200, diff)
+        else:
+            self._send_json(404, {"error": "not found"})
 
     def _handle_debug_schedule(self, pod_name: str) -> None:
         """Explain the last scheduling decision for a pod: the newest

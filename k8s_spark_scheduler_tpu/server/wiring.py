@@ -67,6 +67,7 @@ class Server:
     waste_reporter: "WasteMetricsReporter" = None
     resilience: ResilienceKit = None
     provenance: object = None  # ProvenanceTracker (provenance/tracker.py)
+    capacity: object = None  # CapacitySampler (capacity/observatory.py)
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
@@ -75,6 +76,8 @@ class Server:
         self.unschedulable_marker.start()
         if self.reporters is not None:
             self.reporters.start()
+        if self.capacity is not None:
+            self.capacity.start()
         self._warm_solver_async()
 
     def warmup_complete(self) -> bool:
@@ -294,6 +297,8 @@ class Server:
             self._warm_stop.set()  # signal first; join after the other stops
         if self.reporters is not None:
             self.reporters.stop()
+        if self.capacity is not None:
+            self.capacity.stop()
         self.unschedulable_marker.stop()
         self.resource_reservation_cache.stop()
         self.demand_cache.stop()
@@ -428,6 +433,26 @@ def init_server_with_clients(
             )
         )
 
+    # capacity observatory: fragmentation/headroom analytics + the
+    # /state/capacity timeline, sampled off-lock on ChangeFeed triggers
+    capacity_sampler = None
+    if install.capacity.enabled:
+        from ..capacity import CapacitySampler
+
+        capacity_sampler = CapacitySampler(
+            tensor_snapshot,
+            pod_lister=pod_lister,
+            waste_reporter=waste_reporter,
+            metrics=metrics,
+            instance_group_label=install.instance_group_label,
+            ring_size=install.capacity.ring_size,
+            debounce_seconds=install.capacity.debounce_seconds,
+            interval_seconds=install.capacity.interval_seconds,
+            max_shapes=install.capacity.max_shapes,
+            max_group_zones=install.capacity.max_group_zones,
+            max_queue=install.capacity.max_queue,
+        )
+
     # extender (cmd/server.go:171-191)
     node_sorter = NodeSorter(
         install.driver_prioritized_node_label, install.executor_prioritized_node_label
@@ -503,6 +528,7 @@ def init_server_with_clients(
         waste_reporter=waste_reporter,
         resilience=resilience_kit,
         provenance=provenance_tracker,
+        capacity=capacity_sampler,
     )
     server.reporters = ReporterSet(server)
 

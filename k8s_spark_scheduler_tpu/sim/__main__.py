@@ -97,6 +97,14 @@ def main(argv=None) -> int:
                 f.write(json.dumps(entry, sort_keys=True, separators=(",", ":")) + "\n")
         with open(os.path.join(args.out, "summary.json"), "w") as f:
             json.dump(result.summary, f, indent=2, sort_keys=True)
+        # capacity-observatory timeline (one sample per state-changing
+        # event): the chaos-CI artifact alongside flight-recorder bundles
+        with open(os.path.join(args.out, "capacity.jsonl"), "w") as f:
+            for sample in result.capacity_timeline:
+                f.write(
+                    json.dumps(sample, sort_keys=True, separators=(",", ":"))
+                    + "\n"
+                )
 
     if not args.quiet:
         json.dump(result.summary, sys.stdout, indent=2, sort_keys=True)

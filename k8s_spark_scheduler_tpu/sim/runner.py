@@ -67,6 +67,9 @@ class SimulationResult:
     summary: Dict
     event_log: List[Dict]
     violations: List[str]
+    # capacity-observatory timeline (oldest first, JSON dicts) — the
+    # chaos-CI artifact written as capacity.jsonl next to events.jsonl
+    capacity_timeline: List[Dict] = field(default_factory=list)
 
 
 class Simulation:
@@ -87,6 +90,7 @@ class Simulation:
         self._seq = 0
         self._killed_nodes = 0
         self._scaler: Optional[FakeAutoscaler] = None
+        self._capacity_samples: List = []
         self._pumps_scheduled: set = set()
         self.harness: Optional[Harness] = None
         self.auditor: Optional[Auditor] = None
@@ -138,6 +142,14 @@ class Simulation:
             # sim-driven via unschedulable_scan_interval instead
             unschedulable_polling_interval=1e9,
         )
+        sampler = getattr(self.harness.server, "capacity", None)
+        if sampler is not None:
+            # stopped BEFORE the first node event lands: capacity
+            # sampling is driven by the event loop (post-quiesce,
+            # seq-gated), never by the wall-clock background thread —
+            # the summary's capacity columns and the timeline ring must
+            # be a pure function of (scenario, seed)
+            sampler.stop()
         for i in range(sc.cluster.nodes):
             zone = sc.cluster.zones[i % len(sc.cluster.zones)]
             self.harness.new_node(
@@ -626,6 +638,7 @@ class Simulation:
         self.auditor.check_state(label)
         self._fire_invariant_trigger(label)
         self._schedule_scaler_pumps()
+        self._sample_capacity(label)
         # one API listing per kind per event, shared by the depth gauge,
         # the log entry, and the fingerprint (APIServer.list deepcopies
         # every object — repeating it per consumer multiplied the sim's
@@ -664,6 +677,18 @@ class Simulation:
         self.auditor.check_state(label)
         self._fire_invariant_trigger(label)
         self._schedule_scaler_pumps()
+        self._sample_capacity(label)
+
+    def _sample_capacity(self, label: str) -> None:
+        """One capacity-observatory sample per state-changing event
+        (seq-gated inside the sampler, so idle events are O(1)) —
+        always post-quiesce and never under the predicate lock."""
+        sampler = getattr(self.harness.server, "capacity", None)
+        if sampler is None:
+            return
+        sample = sampler.maybe_sample(trigger=f"sim:{label}")
+        if sample is not None:
+            self._capacity_samples.append(sample)
 
     def _fire_invariant_trigger(self, label: str) -> None:
         """An invariant violation is a flight-recorder trigger: persist
@@ -837,9 +862,88 @@ class Simulation:
             "invariant_violations": len(self.auditor.violations) if self.auditor else -1,
             "digest": digest,
         }
+        summary["capacity"] = self._capacity_summary()
+        summary["waste_phases"] = self._waste_summary()
+        sampler = getattr(self.harness.server, "capacity", None) if self.harness else None
+        timeline = (
+            [s.to_dict() for s in sampler.timeline()] if sampler is not None else []
+        )
         return SimulationResult(
             digest=digest,
             summary=summary,
             event_log=self._log,
             violations=list(self.auditor.violations) if self.auditor else [],
+            capacity_timeline=timeline,
         )
+
+    def _capacity_summary(self) -> Optional[Dict]:
+        """Fragmentation / headroom / queue-pressure percentiles over the
+        event-driven capacity samples — the first ROADMAP-5 scorecard
+        columns.  Virtual-time-deterministic: every input is integer
+        state math on post-quiesce snapshots."""
+        samples = self._capacity_samples
+        if not samples:
+            return None
+
+        def pct(values, q):
+            if not values:
+                return 0.0
+            ordered = sorted(values)
+            return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+        frag = [max(s.frag_index) for s in samples]
+        headroom = [
+            max((i["headroom"] for i in s.headroom.values()), default=0)
+            for s in samples
+        ]
+        pressure = [s.pressure for s in samples]
+        sampler = getattr(self.harness.server, "capacity", None)
+        stats = sampler.stats() if sampler is not None else {}
+        return {
+            "samples": len(samples),
+            "probe_lane": samples[-1].probe_lane,
+            "probe_solves": sum(s.probe_solves for s in samples),
+            "lock_violations": stats.get("lock_violations", 0),
+            "timeline_ring": stats.get("ring", len(samples)),
+            "fragmentation_max_dim": {
+                "p50": round(pct(frag, 0.50), 6),
+                "p95": round(pct(frag, 0.95), 6),
+                "max": round(max(frag), 6),
+                "final": round(frag[-1], 6),
+            },
+            "headroom_executors": {
+                "p50": pct(headroom, 0.50),
+                "p95": pct(headroom, 0.95),
+                "min": min(headroom),
+                "final": headroom[-1],
+            },
+            "queue_pressure": {
+                "p50": pct(pressure, 0.50),
+                "max": max(pressure),
+                "final": pressure[-1],
+            },
+        }
+
+    def _waste_summary(self) -> Dict:
+        """WasteMetricsReporter phase durations (virtual-time seconds)
+        folded in next to the capacity columns."""
+        from ..metrics import names as mnames
+
+        registry = self.harness.server.metrics
+        out = {}
+        for waste_type in (
+            "before-demand-creation",
+            "after-demand-fulfilled",
+            "total-time-no-demand",
+        ):
+            snap = registry.get_histogram(
+                mnames.SCHEDULING_WASTE, {mnames.TAG_WASTE_TYPE: waste_type}
+            )
+            if snap["count"]:
+                out[waste_type] = {
+                    "count": snap["count"],
+                    "mean_s": round(snap["mean"], 6),
+                    "p50_s": round(snap["p50"], 6),
+                    "max_s": round(snap["max"], 6),
+                }
+        return out

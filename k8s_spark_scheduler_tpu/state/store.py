@@ -208,18 +208,31 @@ class ChangeFeed:
         self._ring: Deque[Tuple[int, str, Optional[str]]] = deque(
             maxlen=capacity
         )
+        # optional wakeup Event set on every publish: the capacity
+        # sampler parks on it so sampling happens only on state change
+        # (Event.set is lock-free and idempotent — safe under the
+        # publisher's mirror lock)
+        self._wakeup = None
 
     @property
     def seq(self) -> int:
         with self._lock:
             return self._seq
 
+    def attach_wakeup(self, event) -> None:
+        with self._lock:
+            self._wakeup = event
+
     def publish(self, kind: str, key: Optional[str] = None) -> int:
         with self._lock:
             racecheck.note_access(self, "_seq")
             self._seq += 1
             self._ring.append((self._seq, kind, key))
-            return self._seq
+            seq = self._seq
+            wakeup = self._wakeup
+        if wakeup is not None:
+            wakeup.set()
+        return seq
 
     def kinds_since(self, seq: int):
         """frozenset of delta kinds with sequence > seq, or None when

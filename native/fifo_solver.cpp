@@ -1705,6 +1705,140 @@ int fifo_explain_queue(int64_t nb, int64_t na, const int32_t* avail_rows,
   return 1;
 }
 
+// ---------------------------------------------------------------------------
+// Capacity-observatory probes (ops side: capacity/probe.py).
+//
+// What-if analytics against a FIXED availability basis: the largest
+// gang of a given (driver, executor) shape the solver would admit, and
+// a per-dimension fragmentation report.  Read-only — the planes are
+// never mutated, and nothing here runs on a scheduling hot path.
+// ---------------------------------------------------------------------------
+
+// Batched headroom probe: for each shape s (rows [s*6..s*6+2] driver,
+// [s*6+3..s*6+5] executor, same scaled units as avail_rows), the
+// largest k in [0, k_max] for which the FIFO step at queue position 0
+// would admit a gang of k executors — exactly step_app_plain's
+// feasibility rule (shared by distribute-evenly, and by min-frag whose
+// drain is work-conserving, so one probe covers all three policies).
+//
+// Feasibility is monotone in k: per node min(c,k)·(k+1) ≥ min(c,k+1)·k,
+// so Σ min(c_i,k+1) ≥ k+1 implies Σ min(c_i,k) ≥ k, and the same
+// scaling applies to the with-driver total of the k+1 witness
+// candidate.  Bisection therefore needs O(log k_max) feasibility
+// evaluations; the UNCLAMPED per-node capacities are computed once per
+// shape (they are k-independent), so each evaluation is one clamp-sum
+// sweep plus the driver-candidate walk.
+//
+// Outputs per shape:
+//   out_headroom[s]    largest admissible k (0 = not even one executor,
+//                      or no node covers the driver row)
+//   out_usable[s*3+j]  Σ_i clamp(c_i, 0, k_max) · e_j — scaled units of
+//                      dimension j actually reachable by executors of
+//                      this shape (vs. raw free: the fragmentation gap)
+//   out_probes[s]      feasibility evaluations spent (bisection depth)
+int fifo_probe_headroom(int64_t nb, const int32_t* avail_rows,
+                        const int32_t* driver_rank, const uint8_t* exec_ok,
+                        int64_t nshapes, const int32_t* shapes,
+                        int32_t k_max, int64_t* out_headroom,
+                        int64_t* out_usable, int64_t* out_probes) {
+  if (nb <= 0 || nshapes <= 0 || k_max <= 0) return 0;
+  std::vector<int32_t> cand = build_cand(driver_rank, nb);
+  std::vector<int32_t> a0, a1, a2;
+  split_planes(avail_rows, nb, a0, a1, a2);
+  std::vector<int32_t> caps(nb);
+
+  for (int64_t s = 0; s < nshapes; ++s) {
+    const int32_t* d = shapes + s * 6;
+    const int32_t* e = shapes + s * 6 + 3;
+    // unclamped exact-floor capacities (≤ 0 = ineligible), shared by
+    // every feasibility evaluation of this shape
+    cap_sweeps(a0.data(), a1.data(), a2.data(), nb, e, kMfSent, caps.data());
+    for (int64_t i = 0; i < nb; ++i) {
+      if (!exec_ok[i]) caps[i] = 0;
+    }
+
+    int64_t total_kmax = 0;
+    for (int64_t i = 0; i < nb; ++i) {
+      total_kmax += std::clamp<int32_t>(caps[i], 0, k_max);
+    }
+    for (int j = 0; j < kDims; ++j) {
+      out_usable[s * 3 + j] = total_kmax * static_cast<int64_t>(e[j]);
+    }
+
+    int64_t probes = 0;
+    auto feasible = [&](int32_t k) -> bool {
+      ++probes;
+      int64_t total = 0;
+      for (int64_t i = 0; i < nb; ++i) {
+        total += std::clamp<int32_t>(caps[i], 0, k);
+      }
+      if (total < k) return false;
+      for (int32_t i : cand) {
+        const int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+        if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+        int32_t am[kDims];
+        for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+        const int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+        if (total - std::clamp<int32_t>(caps[i], 0, k) + cwd >= k) {
+          return true;
+        }
+      }
+      return false;
+    };
+
+    int64_t headroom = 0;
+    int64_t hi = std::min<int64_t>(k_max, total_kmax);
+    if (hi >= 1) {
+      if (feasible(static_cast<int32_t>(hi))) {
+        headroom = hi;
+      } else if (feasible(1)) {
+        // invariant: lo feasible, hi infeasible
+        int64_t lo = 1;
+        while (hi - lo > 1) {
+          const int64_t mid = lo + (hi - lo) / 2;
+          if (feasible(static_cast<int32_t>(mid))) {
+            lo = mid;
+          } else {
+            hi = mid;
+          }
+        }
+        headroom = lo;
+      }
+    }
+    out_headroom[s] = headroom;
+    out_probes[s] = probes;
+  }
+  return 1;
+}
+
+// One-sweep per-dimension fragmentation report over the eligible
+// (exec_ok) rows:
+//   out[j*4+0] total free      Σ max(avail_ij, 0)
+//   out[j*4+1] largest chunk   max(avail_ij, 0) over single nodes
+//   out[j*4+2] free nodes      count with avail_ij > 0
+//   out[j*4+3] overdrawn nodes count with avail_ij < 0
+// The fragmentation index (1 − largest/total) is computed by the
+// Python caller, which also rescales to base units.
+int fifo_frag_report(int64_t nb, const int32_t* avail_rows,
+                     const uint8_t* exec_ok, int64_t* out12) {
+  if (nb < 0) return 0;
+  for (int j = 0; j < kDims * 4; ++j) out12[j] = 0;
+  for (int64_t i = 0; i < nb; ++i) {
+    if (!exec_ok[i]) continue;
+    for (int j = 0; j < kDims; ++j) {
+      const int64_t a = avail_rows[i * kDims + j];
+      if (a > 0) {
+        out12[j * 4 + 0] += a;
+        if (a > out12[j * 4 + 1]) out12[j * 4 + 1] = a;
+        ++out12[j * 4 + 2];
+      } else if (a < 0) {
+        ++out12[j * 4 + 3];
+      }
+    }
+  }
+  return 1;
+}
+
 // CPython-compatible float64 sum: the packing-efficiency gauge
 // contract is bit-equality with the host lane's builtin sum().  Which
 // algorithm that is depends on the interpreter: since Python 3.12 the

@@ -102,6 +102,23 @@ def test_bench_final_line_is_the_headline(tmp_path):
             assert prov["explain_p50_ms"] > 0
             assert prov["recorder_note_p50_ms"] >= 0
             assert prov["bundle_file_bytes"] > 0
+
+        # capacity-probe contract (PR 7): when the native probe exists
+        # the bench pins its latency at the bench node shape × 16 gang
+        # shapes, and the bisection depth stays a handful of
+        # feasibility solves per shape
+        from k8s_spark_scheduler_tpu.native.fifo import (
+            native_probe_available,
+        )
+
+        if native_probe_available():
+            capl = artifact["lanes"].get("capacity-probe cpu")
+            assert capl is not None
+            assert capl["probe_p50_ms"] > 0
+            assert capl["shapes"] == 16
+            # ≤ 2 + ceil(log2(k_max)) + 1 evaluations per shape
+            assert 0 < capl["solves_per_probe"] <= 16 * 23
+            assert capl["solves_per_shape_p50"] <= 23
     else:
         assert headline["metric"].startswith("p99_queue_solve")
         assert lane is None

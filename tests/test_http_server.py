@@ -670,6 +670,140 @@ def test_metrics_openmetrics_negotiation(served_fifo):
         assert b"# EOF" not in raw and b"trace_id" not in raw
 
 
+def test_capacity_endpoint_empty_cluster_and_latest(served):
+    """ISSUE 7 satellite: /state/capacity answers 200 with a zeroed
+    sample on an empty cluster, and a populated one after nodes exist;
+    ?ns= scopes the queued-driver forecasts."""
+    api, scheduler, http = served
+
+    status, body = _get(http.port, "/state/capacity")
+    assert status == 200
+    assert body["nodes"] == 0 and body["readyNodes"] == 0
+    assert body["free"] == [0, 0, 0]
+
+    _create_nodes(api)
+    time.sleep(0.2)  # informer events land in the mirror
+    status, body = _get(http.port, "/state/capacity")
+    assert status == 200
+    assert body["nodes"] == 2 and body["readyNodes"] == 2
+    assert body["free"][0] > 0
+    assert len(body["fragIndex"]) == 3
+    assert body["groups"], "per-(group, zone) entries missing"
+    assert body["headroom"], "headroom-by-shape missing"
+    for info in body["headroom"].values():
+        assert info["headroom"] >= 0
+
+    # a pending driver that cannot fit shows up in the queue forecast
+    big = Harness.static_allocation_spark_pods(
+        "app-cap-big", 8, executor_cpu="4", executor_mem="1Gi"
+    )[0]
+    api.create(big)
+    _post(
+        http.port, "/predicates",
+        {"Pod": serde.pod_to_dict(big), "NodeNames": ["n0", "n1"]},
+    )
+    status, body = _get(http.port, "/state/capacity")
+    assert status == 200
+    assert body["queuedGangs"] == 1 and body["pressure"] == 1
+    assert body["queue"][0]["pod"] == big.name
+    assert body["queue"][0]["state"] == "needs-scaleup"
+
+    # ns scoping filters the forecasts, not the cluster aggregates
+    status, scoped = _get(http.port, "/state/capacity?ns=default")
+    assert status == 200 and len(scoped["queue"]) == 1
+    status, scoped = _get(http.port, "/state/capacity?ns=elsewhere")
+    assert status == 200 and scoped["queue"] == []
+    assert scoped["nodes"] == 2
+
+    # group/zone scoping filters the per-group entries
+    status, scoped = _get(http.port, "/state/capacity?zone=z1")
+    assert status == 200 and len(scoped["groups"]) >= 1
+    status, scoped = _get(http.port, "/state/capacity?zone=no-such-zone")
+    assert status == 200 and scoped["groups"] == {}
+
+
+def test_capacity_history_bounds_and_diff(served):
+    api, scheduler, http = served
+    _create_nodes(api)
+    time.sleep(0.2)
+    status, first = _get(http.port, "/state/capacity")
+    assert status == 200
+
+    # a node-structure change between samples
+    from k8s_spark_scheduler_tpu.types.objects import Node, ObjectMeta
+    from k8s_spark_scheduler_tpu.types.resources import Resources, ZONE_LABEL
+
+    api.create(
+        Node(
+            meta=ObjectMeta(
+                name="n-extra",
+                labels={ZONE_LABEL: "z2", "resource_channel": "batch-medium-priority"},
+            ),
+            allocatable=Resources.of("4", "4Gi"),
+        )
+    )
+    time.sleep(0.2)
+    status, second = _get(http.port, "/state/capacity")
+    assert status == 200 and second["nodes"] == 3
+
+    status, hist = _get(http.port, "/state/capacity/history?limit=1")
+    assert status == 200 and len(hist["samples"]) == 1
+    assert hist["samples"][0]["seq"] == second["seq"]
+    status, hist = _get(http.port, "/state/capacity/history")
+    assert status == 200
+    assert len(hist["samples"]) <= hist["ringCapacity"]
+    seqs = [s["seq"] for s in hist["samples"]]
+    assert first["seq"] in seqs and second["seq"] in seqs
+
+    status, diff = _get(
+        http.port,
+        f"/state/capacity/diff?from={first['seq']}&to={second['seq']}",
+    )
+    assert status == 200
+    assert diff["structureChanged"] is True
+    assert diff["nodes"] == 1
+    assert "z2" in " ".join(diff["groupsAdded"])
+
+    assert _get(http.port, "/state/capacity/diff?from=bad&to=1")[0] == 400
+    assert _get(http.port, "/state/capacity/diff?from=999999&to=999998")[0] == 404
+
+
+def test_capacity_gauges_render_in_plain_and_openmetrics(served_fifo):
+    """Satellite: the new capacity gauges follow the PR 6 exposition
+    rules — present in plain 0.0.4 text under every Accept header, and
+    in the opt-in OpenMetrics flavour, which stays exemplar-valid."""
+    api, scheduler, http = served_fifo
+    _create_nodes(api)
+    time.sleep(0.2)
+    assert _get(http.port, "/state/capacity")[0] == 200  # forces a sample
+
+    status, headers, raw = _get_raw(
+        http.port, "/metrics", {"Accept": "text/plain;version=0.0.4"}
+    )
+    assert status == 200
+    plain = raw.decode()
+    assert "foundry_spark_scheduler_tpu_capacity_fragmentation" in plain
+    assert "foundry_spark_scheduler_tpu_capacity_headroom" in plain
+    assert 'dim="cpu"' in plain
+    assert "# EOF" not in plain and "trace_id" not in plain
+
+    status, headers, raw = _get_raw(http.port, "/metrics?format=openmetrics")
+    assert status == 200
+    assert headers.get("Content-Type").startswith("application/openmetrics-text")
+    om = raw.decode()
+    assert "foundry_spark_scheduler_tpu_capacity_fragmentation" in om
+    assert om.rstrip().endswith("# EOF")
+
+    # strict OpenMetrics Accept still gets plain text (PR 6 rule)
+    status, headers, raw = _get_raw(
+        http.port, "/metrics",
+        {"Accept": "application/openmetrics-text;version=1.0.0"},
+    )
+    assert status == 200
+    assert headers.get("Content-Type").startswith("text/plain")
+    assert b"foundry_spark_scheduler_tpu_capacity_fragmentation" in raw
+
+
 def test_traces_limit_param(served_fifo):
     api, scheduler, http = served_fifo
     _create_nodes(api)

@@ -55,6 +55,61 @@ def test_catalog_unique_and_complete():
     assert not missing, f"reference metric names missing: {missing}"
 
 
+def test_no_undeclared_metric_name_literals_in_package():
+    """Drift check (ISSUE 7 satellite): every ``foundry.spark.
+    scheduler.*`` string literal anywhere in the package must be a
+    declared catalog constant — a metric emitted under an inline name
+    is invisible to this contract, to dashboards, and to the docs
+    table.  events/events.py is exempt: those are event-log names, not
+    metrics."""
+    import ast
+    import pathlib
+
+    pkg = pathlib.Path(M.__file__).resolve().parent.parent
+    catalog_values = set(_catalog().values())
+    exempt = {"metrics/names.py", "events/events.py"}
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(pkg).as_posix()
+        if rel in exempt or "__pycache__" in rel:
+            continue
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value.startswith("foundry.spark.scheduler.")
+                and node.value not in catalog_values
+            ):
+                offenders.append(f"{rel}:{node.lineno}: {node.value!r}")
+    assert not offenders, (
+        "metric names emitted outside the catalog (declare them in "
+        "metrics/names.py):\n" + "\n".join(offenders)
+    )
+
+
+def test_every_catalog_name_documented_in_observability_md():
+    """Drift check (ISSUE 7 satellite): every catalog name must appear
+    in a docs/observability.md table, so new metrics (capacity included)
+    can't silently go undocumented."""
+    import pathlib
+
+    doc = (
+        pathlib.Path(M.__file__).resolve().parents[2]
+        / "docs"
+        / "observability.md"
+    ).read_text()
+    missing = [
+        f"{const} = {name}"
+        for const, name in sorted(_catalog().items())
+        if name not in doc
+    ]
+    assert not missing, (
+        "catalog names missing from docs/observability.md:\n"
+        + "\n".join(missing)
+    )
+
+
 def test_tag_keys_match_reference():
     # metrics.go:70-85
     assert M.TAG_SPARK_ROLE == "sparkrole"

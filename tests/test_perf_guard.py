@@ -346,6 +346,52 @@ def test_provenance_overhead_within_budget():
         h.close()
 
 
+def test_capacity_sampler_overhead_within_budget():
+    """ISSUE 7 acceptance: the capacity observatory adds ~nothing to
+    the Filter path — sampling is change-triggered on a background
+    thread and NEVER runs under the extender lock, so the only hot-path
+    cost is the ChangeFeed's wakeup Event.set.  Budget: enabled ≤
+    disabled × 1.05 plus absolute CI-noise slack, same pattern as the
+    resilience/provenance guards."""
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        driver = h.static_allocation_spark_pods("app-cap-perf", 1)[0]
+        h.assert_success(h.schedule(driver, ["n1", "n2"]))  # creates the RR
+
+        extender = h.server.extender
+        sampler = h.server.capacity
+        assert sampler is not None
+        args = ExtenderArgs(pod=driver, node_names=["n1", "n2"])
+        n = 50
+
+        def batch():
+            for _ in range(n):
+                extender.predicate(args)
+
+        batch()  # warm caches/jit
+        sampler.stop()
+        disabled_s = _best_of(batch)
+        sampler.start()
+        batch()  # warm with the thread alive
+        enabled_s = _best_of(batch)
+
+        budget = disabled_s * 1.05 + n * 0.5e-3  # 5% relative + 0.5ms/request
+        assert enabled_s <= budget, (
+            f"capacity sampler overhead: {enabled_s * 1e3:.2f}ms per "
+            f"{n}-request batch enabled vs {disabled_s * 1e3:.2f}ms disabled "
+            f"(budget {budget * 1e3:.2f}ms)"
+        )
+        # and it never probed from inside the extender lock
+        assert sampler.lock_violations == 0
+    finally:
+        h.close()
+
+
 def test_predicate_latency_with_tracing_within_budget():
     from k8s_spark_scheduler_tpu.testing.harness import Harness
 

@@ -156,6 +156,16 @@ def test_chaos_with_delta_engine_enabled_runs_clean_and_bounded():
     assert pstats["ring"]["recorded"] >= result.summary["decisions"]
     assert pstats["ring"]["size"] <= pstats["ring"]["capacity"]
     assert pstats["recorder"]["size"] <= pstats["recorder"]["capacity"]
+    # ISSUE 7 acceptance: the chaos run carries a non-empty, bounded
+    # capacity timeline, the sampler ran zero solves under the extender
+    # lock, and the summary folds the scorecard columns in
+    capsum = result.summary["capacity"]
+    assert capsum is not None and capsum["samples"] > 0
+    assert capsum["lock_violations"] == 0
+    assert result.capacity_timeline
+    sampler = sim.harness.server.capacity
+    assert len(result.capacity_timeline) <= sampler.stats()["ring_capacity"]
+    assert 0.0 <= capsum["fragmentation_max_dim"]["max"] <= 1.0
     engine = sim.harness.server.extender.delta_engine
     from k8s_spark_scheduler_tpu.native.fifo import native_session_available
 
@@ -202,6 +212,9 @@ def test_chaos_with_delta_engine_runs_clean_under_race_detector(monkeypatch):
     assert "ProvenanceRing" in tracked, tracked
     assert "FlightRecorder" in tracked, tracked
     assert "ProvenanceTracker" in tracked, tracked
+    # the capacity sampler's ring/stats are guarded shared state on the
+    # sim's sampling path: instrumented and race-free too
+    assert "CapacitySampler" in tracked, tracked
     assert detector.races == [], "\n".join(detector.report_lines())
     assert detector.lock_order_violations == [], "\n".join(
         detector.report_lines()
