@@ -10,6 +10,11 @@ rc=0
 echo "==> schedlint (python -m k8s_spark_scheduler_tpu.analysis --strict)"
 python -m k8s_spark_scheduler_tpu.analysis --strict || rc=1
 
+echo "==> schedlint native-boundary + lock-coverage audit (--select LK004,NA --strict)"
+# redundant with the full run but named separately, mirroring CI: a
+# Python↔C++ boundary regression should say so, not "lint failed"
+python -m k8s_spark_scheduler_tpu.analysis --strict --select LK004,NA || rc=1
+
 echo "==> native build (native/*.cpp compile + load, incl. the delta-solve session)"
 python - <<'PY' || rc=1
 from k8s_spark_scheduler_tpu.native import native_available

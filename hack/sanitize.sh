@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Native sanitizer lanes: build native/tests/concurrency_smoke.cpp
+# together with the two extension translation units under TSan or
+# ASan+UBSan and run it.  CI's tsan-native / asan-ubsan-native jobs call
+# this; run it locally before touching native/*.cpp.
+#
+#   hack/sanitize.sh tsan   # -fsanitize=thread (SweepPool / session churn)
+#   hack/sanitize.sh asan   # -fsanitize=address,undefined (full API walk)
+#   hack/sanitize.sh        # both
+#
+# Suppressions live in native/tests/tsan.supp — empty by policy unless
+# every entry is justified (see the header there).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+mkdir -p native/_build
+
+SRCS="native/fifo_solver.cpp native/snapshot.cpp native/tests/concurrency_smoke.cpp"
+# -O1: enough to exercise the vectorized loops without optimizing the
+# races away; frame pointers keep sanitizer stacks readable
+COMMON="-std=c++17 -O1 -g -fno-omit-frame-pointer -pthread"
+
+run_tsan() {
+    echo "==> tsan build"
+    g++ $COMMON -fsanitize=thread $SRCS -o native/_build/smoke_tsan
+    echo "==> tsan run (SweepPool + session churn under -fsanitize=thread)"
+    TSAN_OPTIONS="suppressions=native/tests/tsan.supp halt_on_error=1 exitcode=66" \
+        ./native/_build/smoke_tsan
+}
+
+run_asan() {
+    echo "==> asan+ubsan build"
+    g++ $COMMON -fsanitize=address,undefined -fno-sanitize-recover=undefined \
+        $SRCS -o native/_build/smoke_asan
+    echo "==> asan+ubsan run (full native API walk)"
+    ASAN_OPTIONS="detect_leaks=1" ./native/_build/smoke_asan
+}
+
+case "${1:-all}" in
+    tsan) run_tsan ;;
+    asan) run_asan ;;
+    all)  run_tsan; run_asan ;;
+    *) echo "usage: hack/sanitize.sh [tsan|asan|all]" >&2; exit 2 ;;
+esac
+echo "sanitize: clean"

@@ -31,6 +31,11 @@ locking
   LK001  mutation of a @guarded_by attribute outside 'with self.<lock>:'
   LK002  bare .acquire() without try/finally release
   LK003  @guarded_by declaration whose lock attr is never assigned in __init__
+  LK004  threading.Lock attribute + mutating methods but no @guarded_by
+
+native boundary (Python↔C++ via ctypes)
+  NA001  native call while holding a @guarded_by lock (not on the GIL-safe list)
+  NA002  raw native ._handle referenced outside the native/ binding package
 
 tracer-safety (JAX kernels)
   JX001  Python if/while on a traced value inside a jitted function

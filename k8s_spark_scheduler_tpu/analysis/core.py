@@ -213,7 +213,7 @@ def analyze_paths(
     """Analyze the given files/directories.  ``root`` anchors the
     package-relative paths used by pragmas/allowlists (defaults to the
     installed package directory)."""
-    from . import rules_jax, rules_locks, rules_time
+    from . import rules_jax, rules_locks, rules_native, rules_time
 
     config = config or AnalysisConfig()
     root = os.path.abspath(root or package_root())
@@ -251,6 +251,7 @@ def analyze_paths(
         raw.extend(rules_time.check(ctx))
         raw.extend(rules_locks.check(ctx))
         raw.extend(rules_jax.check(ctx))
+        raw.extend(rules_native.check(ctx))
 
         for finding in raw:
             if not config.rule_selected(finding.rule):

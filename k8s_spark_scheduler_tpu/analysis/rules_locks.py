@@ -25,6 +25,12 @@ Rules:
 - **LK003** — a ``@guarded_by`` declaration whose lock attribute is
   never assigned in ``__init__``: the declaration is dead and the rule
   family silently stops protecting the class.
+- **LK004** — a class assigns a ``threading.Lock``/``RLock`` attribute
+  in ``__init__`` and has mutating methods, but declares no
+  ``@guarded_by``: the lock exists, yet neither the LK001 lexical check
+  nor the runtime race detectors can see what it guards.  Either
+  declare the guarded fields or carry a justified pragma on the class
+  line (e.g. a lock that guards no *fields* — a pure serializer).
 """
 
 from __future__ import annotations
@@ -170,6 +176,8 @@ class _ClassChecker:
                 yield block
         for handler in getattr(stmt, "handlers", ()) or ():
             yield handler.body
+        for case in getattr(stmt, "cases", ()) or ():
+            yield case.body
 
     def _mutations_in(self, stmt: ast.stmt):
         """(field, node) pairs for direct mutations in this statement
@@ -225,6 +233,83 @@ class _ClassChecker:
                 if name and name in self.fields:
                     out.append((name, expr))
         return out
+
+
+# -- LK004: a lock with no @guarded_by declaration ----------------------------
+
+
+_LOCK_FACTORIES = {"Lock", "RLock"}
+
+
+def _init_lock_attrs(cls: ast.ClassDef) -> List[str]:
+    """self attributes assigned a threading.Lock()/RLock() in __init__."""
+    out: List[str] = []
+    for stmt in cls.body:
+        if not (isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__"):
+            continue
+        for node in ast.walk(stmt):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)):
+                continue
+            fn = value.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name not in _LOCK_FACTORIES:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                attr = _is_self_attr(t)
+                if attr:
+                    out.append(attr)
+    return out
+
+
+def _has_mutating_method(cls: ast.ClassDef) -> bool:
+    """Any non-__init__ method that assigns a self attribute / subscript
+    or calls a known mutating method on one."""
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if stmt.name == "__init__":
+            continue
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if _is_self_attr(t):
+                        return True
+                    if isinstance(t, ast.Subscript) and _is_self_attr(t.value):
+                        return True
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_METHODS
+                and _is_self_attr(node.func.value)
+            ):
+                return True
+    return False
+
+
+def _check_lk004(ctx: FileContext, cls: ast.ClassDef) -> List[Finding]:
+    lock_attrs = _init_lock_attrs(cls)
+    if not lock_attrs or not _has_mutating_method(cls):
+        return []
+    return [_finding(
+        ctx, "LK004", cls,
+        f"{cls.name} assigns {', '.join('self.' + a for a in lock_attrs)} "
+        "but declares no @guarded_by: neither the LK001 lexical check nor "
+        "the runtime race detectors can see what the lock guards",
+        cls.name,
+    )]
 
 
 # -- LK002: acquire() without try/finally -------------------------------------
@@ -284,6 +369,8 @@ class _AcquireVisitor(ast.NodeVisitor):
                 block = getattr(stmt, attr, None)
                 if isinstance(block, list):
                     yield block, False
+            for case in getattr(stmt, "cases", ()) or ():
+                yield case.body, False
 
     @staticmethod
     def _is_acquire_call(expr: ast.AST) -> bool:
@@ -313,6 +400,8 @@ def check(ctx: FileContext) -> List[Finding]:
             if decl is not None:
                 lock_attr, fields = decl
                 findings.extend(_ClassChecker(ctx, node, lock_attr, fields).run())
+            else:
+                findings.extend(_check_lk004(ctx, node))
     acquire_visitor = _AcquireVisitor(ctx)
     acquire_visitor.visit(ctx.tree)
     findings.extend(acquire_visitor.findings)

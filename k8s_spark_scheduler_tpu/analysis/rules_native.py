@@ -1,0 +1,215 @@
+"""NA — the Python↔C++ boundary audit.
+
+The native extension (``native/fifo_solver.cpp``, ``native/snapshot.cpp``)
+is reached through ctypes, which means every call crosses a contract no
+existing tool checks from either side:
+
+- **NA001** — a native-boundary call inside a ``with self.<lock>:``
+  block of a ``@guarded_by`` class.  ctypes releases the GIL around
+  every foreign call, so a native call under a guarded lock (a) extends
+  the lock hold by the whole native runtime — a queue solve at 10k
+  nodes is ~18 ms of hold time on what is usually a bookkeeping lock —
+  and (b) invites real parallelism behind a lock the rest of the code
+  believes serializes.  The only legal in-lock crossings are the ones
+  on the GIL-safe list below: O(1) accessors that return immediately
+  and touch no shared native state.  Everything else moves outside the
+  lock (the delta-solve engine's ``solve()`` runs its native step
+  outside ``_lock`` for exactly this reason) or carries a justified
+  pragma.
+- **NA002** — a raw native handle (an attribute named ``_handle``, the
+  ctypes void-pointer) referenced outside the ``native/`` binding
+  package.  Raw handles carry no lifetime protection: the binding
+  classes (``NativeFifoSession``, ``SnapshotMaintainer``) refcount them
+  and free the C++ state in ``__del__``/``close``, so a handle that
+  escapes the binding can outlive its session — a use-after-free the
+  sanitizer lanes can only catch if a test happens to hit it.  Sessions
+  escape the engine's lock scope only as their refcounted wrapper,
+  never as the raw pointer.
+
+Detection is lexical, matching the project's binding idioms: calls to
+names imported from a ``native`` module, and calls through attribute
+chains containing ``native`` or ``_lib`` (``sess.native.solve(...)``,
+``self._lib.snap_read(...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import FileContext, Finding
+from .rules_locks import _guarded_decl, _with_holds_lock
+
+# In-lock native calls that are proven O(1), GIL-hold-trivial accessors.
+# Every entry carries its justification here — this list is the rule's
+# contract, reviewed like an allowlist.
+GIL_SAFE_NATIVE_CALLS = {
+    # reads one cached int64 from the session struct; no allocation, no
+    # solver state touched (fifo_solver.cpp fifo_sess_mem_bytes)
+    "mem_bytes",
+}
+
+# attribute/receiver names that mark a call as crossing the boundary
+_BOUNDARY_MARKERS = {"native", "_lib"}
+
+
+def _finding(ctx: FileContext, rule: str, node: ast.AST, message: str,
+             symbol: str) -> Finding:
+    return Finding(
+        rule=rule,
+        category="native-boundary",
+        file=ctx.relpath,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+        symbol=symbol,
+    )
+
+
+def _native_imported_names(tree: ast.Module) -> Set[str]:
+    """Names bound by ``from ...native[...] import X [as Y]`` anywhere in
+    the file (module- or function-level)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            parts = module.split(".")
+            if "native" in parts:
+                for alias in node.names:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """['self', '_lib', 'snap_read'] for ``self._lib.snap_read``."""
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        chain.append(node.id)
+    chain.reverse()
+    return chain
+
+
+def _native_call_name(call: ast.Call, imported: Set[str]) -> Optional[str]:
+    """The called symbol when this call crosses the native boundary."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id if fn.id in imported else None
+    if isinstance(fn, ast.Attribute):
+        chain = _attr_chain(fn)
+        # the final element is the callee; boundary if any RECEIVER link
+        # is a marker, or the callee resolves to an imported native name
+        if any(link in _BOUNDARY_MARKERS for link in chain[:-1]):
+            return chain[-1]
+        if chain and chain[0] in imported:
+            return chain[-1]
+    return None
+
+
+class _Na001Checker:
+    """Walks a @guarded_by class, tracking the declared-lock scope."""
+
+    def __init__(self, ctx: FileContext, cls: ast.ClassDef, lock_attr: str,
+                 imported: Set[str]):
+        self.ctx = ctx
+        self.cls = cls
+        self.lock_attr = lock_attr
+        self.imported = imported
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        for stmt in self.cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(stmt.body, False, stmt.name)
+        return self.findings
+
+    def _walk(self, stmts, lock_held: bool, method: str) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                held = lock_held or _with_holds_lock(stmt, self.lock_attr)
+                self._walk(stmt.body, held, method)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(stmt.body, False, stmt.name)
+                continue
+            if lock_held:
+                # one flat scan that skips nested defs (they run later,
+                # lock-free); no recursion afterwards — recursing too
+                # would report each nested call once per block level
+                self._report_calls(stmt, method)
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                block = getattr(stmt, attr, None)
+                if isinstance(block, list):
+                    self._walk(block, lock_held, method)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                self._walk(handler.body, lock_held, method)
+            for case in getattr(stmt, "cases", ()) or ():
+                self._walk(case.body, lock_held, method)
+
+    def _report_calls(self, stmt: ast.stmt, method: str) -> None:
+        stack: List[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # deferred body: not under the lock when it runs
+            if isinstance(node, ast.Call):
+                callee = _native_call_name(node, self.imported)
+                if callee is not None and callee not in GIL_SAFE_NATIVE_CALLS:
+                    self.findings.append(_finding(
+                        self.ctx, "NA001", node,
+                        f"native-boundary call {callee}() while "
+                        f"holding self.{self.lock_attr}: ctypes "
+                        "releases the GIL, so the guarded lock is "
+                        "held across foreign code — move the call "
+                        "outside the lock or add it to "
+                        "GIL_SAFE_NATIVE_CALLS with a justification",
+                        f"{self.cls.name}.{method}",
+                    ))
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_na002(ctx: FileContext) -> List[Finding]:
+    if ctx.relpath.startswith("native/"):
+        return []
+    findings: List[Finding] = []
+    scope: List[str] = []
+
+    def visit(node: ast.AST) -> None:
+        pushed = False
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            scope.append(node.name)
+            pushed = True
+        if isinstance(node, ast.Attribute) and node.attr == "_handle":
+            findings.append(_finding(
+                ctx, "NA002", node,
+                "raw native handle ._handle referenced outside the "
+                "native/ binding package: handles carry no lifetime "
+                "protection — pass the refcounted wrapper "
+                "(NativeFifoSession / SnapshotMaintainer) instead",
+                ".".join(scope),
+            ))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if pushed:
+            scope.pop()
+
+    visit(ctx.tree)
+    return findings
+
+
+def check(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    imported = _native_imported_names(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            decl = _guarded_decl(node)
+            if decl is not None:
+                lock_attr, _fields = decl
+                findings.extend(
+                    _Na001Checker(ctx, node, lock_attr, imported).run()
+                )
+    findings.extend(_check_na002(ctx))
+    return findings

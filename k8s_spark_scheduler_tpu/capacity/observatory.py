@@ -224,11 +224,15 @@ class CapacitySampler:
             thread.join(timeout=5.0)
 
     def _loop(self) -> None:
+        feed = getattr(self._cache, "feed", None)
         while not self._stop.is_set():
             fired = self._wake.wait(timeout=self.interval_seconds)
             if self._stop.is_set():
                 return
             if fired:
+                if feed is not None and hasattr(feed, "hb_channel"):
+                    # the observe side of the feed's publish→wakeup edge
+                    racecheck.hb_observe(feed.hb_channel())
                 self._wake.clear()
                 # debounce: let the burst (one gang = many deltas) land
                 # before paying one sample for all of it

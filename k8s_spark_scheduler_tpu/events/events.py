@@ -14,6 +14,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List
+from ..analysis import racecheck
+from ..analysis.guarded import guarded_by
 
 logger = logging.getLogger("k8s_spark_scheduler_tpu.events")
 
@@ -33,6 +35,7 @@ class Event:
     trace_id: str = ""
 
 
+@guarded_by("_lock", "_events")
 class EventLog:
     def __init__(self, capacity: int = 4096):
         self._events: deque[Event] = deque(maxlen=capacity)
@@ -43,6 +46,7 @@ class EventLog:
 
         event = Event(name, values, trace_id=current_trace_id() or "")
         with self._lock:
+            racecheck.note_access(self, "_events")
             self._events.append(event)
         if event.trace_id:
             logger.info("%s traceId=%s %s", name, event.trace_id, values)

@@ -26,6 +26,7 @@ from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..types.objects import APIObject
+from ..analysis.guarded import guarded_by
 from .errors import (
     AlreadyExistsError,
     ConflictError,
@@ -40,6 +41,7 @@ MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 
 
+@guarded_by("_lock", "_objects", "_uid_counts", "_owner_index", "_watchers", "_terminating_namespaces", "_crds")
 class APIServer:
     """In-memory resource-versioned object store with watch fan-out."""
 
@@ -174,13 +176,13 @@ class APIServer:
             if not ref.uid:
                 continue
             if add:
-                self._owner_index.setdefault(ref.uid, set()).add(entry)
+                self._owner_index.setdefault(ref.uid, set()).add(entry)  # schedlint: disable=LK001 -- private helper, every caller holds _lock (see callers)
             else:
                 deps = self._owner_index.get(ref.uid)
                 if deps is not None:
                     deps.discard(entry)
                     if not deps:
-                        del self._owner_index[ref.uid]
+                        del self._owner_index[ref.uid]  # schedlint: disable=LK001 -- private helper, every caller holds _lock (see callers)
 
     def update(self, obj: APIObject) -> APIObject:
         self._check_write_fault("update", obj.KIND, obj.namespace, obj.name)

@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Iterator, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
+from ..analysis.guarded import guarded_by
 
 from .errors import (
     AlreadyExistsError,
@@ -195,6 +196,7 @@ class GoneError(APIError):
     reason = "Gone"
 
 
+@guarded_by("_token_lock", "_token")
 class RestClient:
     """Thin requester with per-host connection reuse and a write-side
     token bucket (QPS/Burst, ratelimit.py — reads are unthrottled, like

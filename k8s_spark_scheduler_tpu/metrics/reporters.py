@@ -24,6 +24,7 @@ from ..scheduler import labels as L
 from ..types.resources import Resources
 from . import names
 from .registry import MetricsRegistry
+from ..analysis.guarded import guarded_by
 
 logger = logging.getLogger(__name__)
 
@@ -35,6 +36,7 @@ def _percentile(sorted_values: List[float], q: float) -> float:
     return sorted_values[idx]
 
 
+@guarded_by("_delay_lock", "_delays")
 class ReporterSet:
     def __init__(self, server, tick_seconds: float = names.TICK_INTERVAL_SECONDS):
         self._server = server

@@ -25,6 +25,7 @@ from ..scheduler import labels as L
 from ..types.objects import Demand, Pod
 from . import names
 from .registry import MetricsRegistry
+from ..analysis.guarded import guarded_by
 
 logger = logging.getLogger(__name__)
 
@@ -42,6 +43,7 @@ class _PodSchedulingInfo:
     created_at: float = field(default_factory=timesource.now)
 
 
+@guarded_by("_lock", "_info")
 class WasteMetricsReporter:
     def __init__(self, metrics: MetricsRegistry, instance_group_label: str):
         self._metrics = metrics
@@ -188,7 +190,7 @@ class WasteMetricsReporter:
     def _get_or_create(self, namespace: str, pod_name: str) -> _PodSchedulingInfo:
         info = self._info.get((namespace, pod_name))
         if info is None:
-            info = self._info[(namespace, pod_name)] = _PodSchedulingInfo()
+            info = self._info[(namespace, pod_name)] = _PodSchedulingInfo()  # schedlint: disable=LK001 -- private helper, every caller holds _lock (see callers)
         return info
 
     def scheduling_info(self, namespace: str, pod_name: str):

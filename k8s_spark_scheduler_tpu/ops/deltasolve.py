@@ -109,7 +109,7 @@ class _Session:
     content_key: tuple        # snapshot content sequence last verified
 
 
-@guarded_by("_lock", "_sessions", "_stats", "_resume_depths")
+@guarded_by("_lock", "_sessions", "_stats", "_resume_depths", "_parity_count")
 class DeltaSolveEngine:
     """Serves the whole FIFO driver decision from resident native state
     when it can, falling back (``solve`` → None) to the per-request
@@ -358,8 +358,18 @@ class DeltaSolveEngine:
             if warm:
                 self._record_warm(resume)
                 if self.parity_interval:
-                    self._parity_count += 1
-                    if self._parity_count % self.parity_interval == 0:
+                    # counted under the engine lock: solve() already runs
+                    # concurrently in tests and will for real once the
+                    # extender lock splits (ROADMAP-1) — an unguarded
+                    # += here was the PR 9 vector-clock detector's first
+                    # real finding
+                    with self._lock:
+                        racecheck.note_access(self, "_parity_count")
+                        self._parity_count += 1
+                        parity_due = (
+                            self._parity_count % self.parity_interval == 0
+                        )
+                    if parity_due:
                         self._verify_parity(
                             sess, packed, feasible, didx, avail_after
                         )

@@ -52,6 +52,9 @@ class IntentJournal:
         self._path = path
         self._metrics = metrics
         self._lock = threading.Lock()
+        # persist→replay happens-before channel; a process-unique token
+        # so a recycled object id can never alias journals
+        self._hb_key = ("journal", racecheck.channel_token())
         self._seq = 0
         # key → intent dict (latest wins)
         self._pending: Dict[Key, dict] = {}
@@ -127,6 +130,10 @@ class IntentJournal:
             }
             self._pending[(namespace, name)] = rec
             self._append_line(rec)
+            # persist → replay edge: the recovery loop that reads
+            # pending() is ordered after everything recorded here, even
+            # when it synchronizes through the file rather than a lock
+            racecheck.hb_publish(self._hb_key)
             self._report_depth()
             if self._metrics is not None:
                 from ..metrics import names as mnames
@@ -162,6 +169,7 @@ class IntentJournal:
 
     def pending(self) -> List[dict]:
         """Copies of pending intents in seq order."""
+        racecheck.hb_observe(self._hb_key)
         with self._lock:
             return sorted((dict(r) for r in self._pending.values()), key=lambda r: r["seq"])
 

@@ -21,7 +21,10 @@ import itertools
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from ..analysis.guarded import guarded_by
 
+
+@guarded_by("_lock", "_heap", "_now")
 class VirtualClock:
     def __init__(self, start: float = 0.0):
         self._now = start

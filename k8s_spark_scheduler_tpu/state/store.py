@@ -213,6 +213,10 @@ class ChangeFeed:
         # (Event.set is lock-free and idempotent — safe under the
         # publisher's mirror lock)
         self._wakeup = None
+        # happens-before channel key for the publish→wakeup edge; a
+        # process-unique token, captured once, so a recycled object id
+        # can never alias this feed's clock to another feed's
+        self._hb_key = ("changefeed", racecheck.channel_token())
 
     @property
     def seq(self) -> int:
@@ -231,8 +235,17 @@ class ChangeFeed:
             seq = self._seq
             wakeup = self._wakeup
         if wakeup is not None:
+            # Event.set is synchronization the lock tracker can't see:
+            # record the publish→wakeup happens-before edge explicitly
+            # (the sampler's wait side calls hb_observe on this channel)
+            racecheck.hb_publish(self.hb_channel())
             wakeup.set()
         return seq
+
+    def hb_channel(self) -> tuple:
+        """The happens-before channel key for this feed's publish →
+        wakeup edge (racecheck.hb_observe after a wakeup-event wait)."""
+        return self._hb_key
 
     def kinds_since(self, seq: int):
         """frozenset of delta kinds with sequence > seq, or None when
@@ -304,7 +317,14 @@ class ShardedUniqueQueue:
         return self._queues[self._bucket(r.key)]
 
     def _release_func(self, r: Request) -> Callable[[], Request]:
+        # queue-handoff happens-before edge, carried INSIDE the item:
+        # the consumer inherits the producer's clock exactly when the
+        # enqueue succeeded (a Full shard drops the closure, so a failed
+        # handoff can neither order nor hide anything)
+        snapshot = racecheck.hb_snapshot()
+
         def release() -> Request:
+            racecheck.hb_join(snapshot)
             self._delete_from_inflight(r.key)
             return r
 

@@ -40,6 +40,7 @@ from ..kube.errors import (
     NotFoundError,
 )
 from ..kube.restbackend import _RESOURCES, RestAPIServer
+from ..analysis.guarded import guarded_by
 
 _PATHS = {
     ("", "v1", "pods"): "Pod",
@@ -87,6 +88,7 @@ def _error_to_status(err: Exception) -> Tuple[int, dict]:
     return 500, _status(500, "InternalError", str(err))
 
 
+@guarded_by("_lock", "_history", "_oldest", "_subscribers")
 class FakeKubeAPI:
     """HTTP facade over an embedded APIServer store."""
 
@@ -120,16 +122,16 @@ class FakeKubeAPI:
                 pass
 
             def do_GET(self):
-                fake._handle(self, "GET")
+                fake._handle_http(self, "GET")
 
             def do_POST(self):
-                fake._handle(self, "POST")
+                fake._handle_http(self, "POST")
 
             def do_PUT(self):
-                fake._handle(self, "PUT")
+                fake._handle_http(self, "PUT")
 
             def do_DELETE(self):
-                fake._handle(self, "DELETE")
+                fake._handle_http(self, "DELETE")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -180,7 +182,7 @@ class FakeKubeAPI:
 
     # -- request dispatch ----------------------------------------------------
 
-    def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+    def _handle_http(self, req: BaseHTTPRequestHandler, method: str) -> None:
         try:
             split = urlsplit(req.path)
             params = {k: v[0] for k, v in parse_qs(split.query).items()}

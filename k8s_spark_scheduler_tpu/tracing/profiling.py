@@ -31,6 +31,7 @@ from typing import Any, Optional, Set, Tuple
 
 from ..metrics import names as mnames
 from .spans import NOOP_SPAN, Tracer, current_span, default_tracer
+from ..analysis.guarded import guarded_by
 
 
 def jit_cache_size(fn) -> Optional[int]:
@@ -132,6 +133,7 @@ class _Profile:
         self._span.tag("cacheHit", not miss)
 
 
+@guarded_by("_seen_lock", "_seen")
 class KernelProfiler:
     """Profiling sink: records into a metrics registry and the active
     trace.  One module-level instance (``default_profiler``) is rebound

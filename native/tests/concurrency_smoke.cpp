@@ -1,0 +1,349 @@
+// Sanitizer smoke for the native layer's concurrency surface.
+//
+// Compiled together with fifo_solver.cpp and snapshot.cpp (they are
+// plain translation units with extern "C" APIs) under either
+//   -fsanitize=thread            (hack/sanitize.sh tsan)
+//   -fsanitize=address,undefined (hack/sanitize.sh asan)
+// and run to completion.  Any sanitizer report exits nonzero, so the CI
+// lanes gate on a clean run.
+//
+// What it exercises, and why:
+//  1. stateless queue solves from many threads over SHARED read-only
+//     inputs — the pattern the ROADMAP-1 parallel admission pipeline
+//     will run (concurrent Filter solves against one basis);
+//  2. per-thread FifoSession instances whose SweepPool worker threads
+//     (condvar-coordinated sharded capacity sweeps) run CONCURRENTLY
+//     with each other — the only multi-threaded code inside the
+//     extension today, previously unsanitized;
+//  3. session load/solve/destroy churn across threads — the engine's
+//     LRU eviction frees sessions on whatever thread drops the last
+//     reference, so create/destroy must be clean off the owning thread;
+//  4. warm-resume parity: every session solve is checked byte-for-byte
+//     against the stateless cold solve, so the smoke is also a
+//     correctness harness, not just a crash test;
+//  5. the snapshot maintainer API (load/apply/read/scale/rows-diff)
+//     under ASan/UBSan — single-threaded by contract, but every array
+//     walk and allocation is bounds- and UB-checked.
+//
+// Deliberately NOT exercised: concurrent calls into ONE session — the
+// binding documents sessions as not thread-safe (the engine serializes
+// per-session access), so sanitizing that would "prove" a contract the
+// code does not offer.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// extern "C" surface under test (mirrors the ctypes bindings in
+// k8s_spark_scheduler_tpu/native/__init__.py and native/fifo.py)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+int fifo_solve_queue(int64_t nb, int64_t na, int32_t* avail_io,
+                     const int32_t* driver_rank, const uint8_t* exec_ok,
+                     const int32_t* drivers, const int32_t* executors,
+                     const int32_t* counts, const uint8_t* app_valid,
+                     int evenly, uint8_t* out_feas, int32_t* out_didx);
+int fifo_solve_queue_minfrag(int64_t nb, int64_t na, int32_t* avail_io,
+                             const int32_t* driver_rank,
+                             const uint8_t* exec_ok, const int32_t* drivers,
+                             const int32_t* executors, const int32_t* counts,
+                             const uint8_t* app_valid, uint8_t* out_feas,
+                             int32_t* out_didx);
+void* fifo_sess_create();
+void fifo_sess_destroy(void* handle);
+int fifo_sess_load(void* handle, int64_t nb, const int32_t* avail_rows,
+                   const int32_t* driver_rank, const uint8_t* exec_ok,
+                   int policy, int64_t stride, int n_threads,
+                   int64_t min_pool_nodes);
+int64_t fifo_sess_solve(void* handle, int64_t na, const int32_t* apps8,
+                        uint8_t* out_feas, int32_t* out_didx,
+                        int32_t* out_avail_rows);
+int64_t fifo_sess_mem_bytes(void* handle);
+int fifo_explain_queue(int64_t nb, int64_t na, const int32_t* avail,
+                       const int32_t* driver_rank, const uint8_t* exec_ok,
+                       const int32_t* apps8, int policy, int64_t target,
+                       uint8_t* out_blockers, int64_t* out_info);
+int fifo_probe_headroom(int64_t nb, const int32_t* avail,
+                        const int32_t* driver_rank, const uint8_t* exec_ok,
+                        int64_t n_shapes, const int32_t* shapes,
+                        int32_t k_max, int64_t* out_headroom,
+                        int64_t* out_usable, int64_t* out_probes);
+int fifo_frag_report(int64_t nb, const int32_t* avail, const uint8_t* exec_ok,
+                     int64_t* out12);
+
+void* snap_create(int64_t n_nodes);
+void snap_destroy(void* handle);
+int64_t snap_size(void* handle);
+int snap_load(void* handle, const int64_t* rows, int64_t n);
+void snap_apply_deltas(void* handle, const int32_t* idx, const int64_t* deltas,
+                       int64_t n);
+void snap_read(void* handle, int64_t* out);
+int snap_scale_int32(void* handle, const int64_t* demands, int64_t n_demands,
+                     int64_t node_bucket, int32_t* out_avail,
+                     int32_t* out_demands, int64_t* out_scale);
+int64_t snap_rows_diff(const int64_t* a, const int64_t* b, int64_t n);
+}
+
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int64_t kNodes = 96;
+constexpr int64_t kApps = 24;
+
+struct Fixture {
+  std::vector<int32_t> avail;        // [kNodes, 3]
+  std::vector<int32_t> rank;         // [kNodes]
+  std::vector<uint8_t> exec_ok;      // [kNodes]
+  std::vector<int32_t> drivers;      // [kApps, 3]
+  std::vector<int32_t> executors;    // [kApps, 3]
+  std::vector<int32_t> counts;       // [kApps]
+  std::vector<uint8_t> valid;        // [kApps]
+  std::vector<int32_t> apps8;        // [kApps, 8] session packing
+
+  Fixture() {
+    avail.resize(kNodes * 3);
+    rank.resize(kNodes);
+    exec_ok.resize(kNodes);
+    for (int64_t i = 0; i < kNodes; ++i) {
+      // deterministic, mildly heterogeneous capacities
+      avail[i * 3 + 0] = 16 + static_cast<int32_t>(i % 7) * 4;
+      avail[i * 3 + 1] = 64 + static_cast<int32_t>(i % 5) * 16;
+      avail[i * 3 + 2] = (i % 11 == 0) ? 8 : 0;
+      rank[i] = static_cast<int32_t>((i * 37) % kNodes);
+      exec_ok[i] = (i % 9 != 0) ? 1 : 0;
+    }
+    drivers.resize(kApps * 3);
+    executors.resize(kApps * 3);
+    counts.resize(kApps);
+    valid.resize(kApps);
+    apps8.resize(kApps * 8);
+    for (int64_t a = 0; a < kApps; ++a) {
+      drivers[a * 3 + 0] = 2 + static_cast<int32_t>(a % 3);
+      drivers[a * 3 + 1] = 8;
+      drivers[a * 3 + 2] = 0;
+      executors[a * 3 + 0] = 4;
+      executors[a * 3 + 1] = 16 + static_cast<int32_t>(a % 2) * 8;
+      executors[a * 3 + 2] = 0;
+      counts[a] = 1 + static_cast<int32_t>(a % 5);
+      valid[a] = 1;
+      for (int d = 0; d < 3; ++d) {
+        apps8[a * 8 + d] = drivers[a * 3 + d];
+        apps8[a * 8 + 3 + d] = executors[a * 3 + d];
+      }
+      apps8[a * 8 + 6] = counts[a];
+      apps8[a * 8 + 7] = 1;
+    }
+  }
+};
+
+struct Verdict {
+  std::vector<uint8_t> feas;
+  std::vector<int32_t> didx;
+  std::vector<int32_t> avail_after;
+};
+
+Verdict cold_solve(const Fixture& fx, int64_t na, int policy) {
+  Verdict v;
+  v.feas.assign(na, 0);
+  v.didx.assign(na, 0);
+  v.avail_after = fx.avail;  // mutated in place by the solver
+  if (policy == 2) {
+    fifo_solve_queue_minfrag(kNodes, na, v.avail_after.data(),
+                             fx.rank.data(), fx.exec_ok.data(),
+                             fx.drivers.data(), fx.executors.data(),
+                             fx.counts.data(), fx.valid.data(),
+                             v.feas.data(), v.didx.data());
+  } else {
+    fifo_solve_queue(kNodes, na, v.avail_after.data(), fx.rank.data(),
+                     fx.exec_ok.data(), fx.drivers.data(),
+                     fx.executors.data(), fx.counts.data(), fx.valid.data(),
+                     policy == 1 ? 1 : 0, v.feas.data(), v.didx.data());
+  }
+  return v;
+}
+
+int failures = 0;
+
+void check(bool ok, const char* what) {
+  if (!ok) {
+    std::fprintf(stderr, "FAIL: %s\n", what);
+    ++failures;
+  }
+}
+
+// 1 + 4: per-thread sessions with a forced SweepPool, warm resumes
+// checked byte-for-byte against the stateless cold solver.
+void session_worker(const Fixture* fx, int policy, int iters) {
+  void* sess = fifo_sess_create();
+  check(sess != nullptr, "fifo_sess_create");
+  // min_pool_nodes=1 forces the condvar pool on at this node count
+  check(fifo_sess_load(sess, kNodes, fx->avail.data(), fx->rank.data(),
+                       fx->exec_ok.data(), policy, /*stride=*/4,
+                       /*n_threads=*/4, /*min_pool_nodes=*/1) == 1,
+        "fifo_sess_load");
+  for (int it = 0; it < iters; ++it) {
+    // vary the queue length so warm resumes hit different checkpoints
+    int64_t na = 1 + (it * 7) % kApps;
+    std::vector<uint8_t> feas(na, 0);
+    std::vector<int32_t> didx(na, 0);
+    std::vector<int32_t> after(kNodes * 3, 0);
+    int64_t resume = fifo_sess_solve(sess, na, fx->apps8.data(), feas.data(),
+                                     didx.data(), after.data());
+    check(resume >= 0, "fifo_sess_solve resume");
+    Verdict cold = cold_solve(*fx, na, policy);
+    check(std::memcmp(feas.data(), cold.feas.data(), na) == 0,
+          "warm/cold feasibility parity");
+    check(std::memcmp(didx.data(), cold.didx.data(), na * 4) == 0,
+          "warm/cold driver-index parity");
+    check(std::memcmp(after.data(), cold.avail_after.data(),
+                      kNodes * 3 * 4) == 0,
+          "warm/cold avail-after parity");
+  }
+  check(fifo_sess_mem_bytes(sess) > 0, "fifo_sess_mem_bytes");
+  fifo_sess_destroy(sess);
+}
+
+// 2: stateless solves from many threads over shared read-only inputs.
+void stateless_worker(const Fixture* fx, const Verdict* expect, int iters) {
+  for (int it = 0; it < iters; ++it) {
+    Verdict v = cold_solve(*fx, kApps, 0);
+    check(v.feas == expect->feas, "stateless repeat feasibility");
+    check(v.didx == expect->didx, "stateless repeat driver indices");
+  }
+}
+
+// 3: create/load/destroy churn across threads.
+void churn_worker(const Fixture* fx, int iters) {
+  for (int it = 0; it < iters; ++it) {
+    void* sess = fifo_sess_create();
+    check(sess != nullptr, "churn create");
+    check(fifo_sess_load(sess, kNodes, fx->avail.data(), fx->rank.data(),
+                         fx->exec_ok.data(), /*policy=*/it % 2, /*stride=*/8,
+                         /*n_threads=*/2, /*min_pool_nodes=*/1) == 1,
+          "churn load");
+    std::vector<uint8_t> feas(kApps, 0);
+    std::vector<int32_t> didx(kApps, 0);
+    std::vector<int32_t> after(kNodes * 3, 0);
+    check(fifo_sess_solve(sess, kApps, fx->apps8.data(), feas.data(),
+                          didx.data(), after.data()) >= 0,
+          "churn solve");
+    fifo_sess_destroy(sess);
+  }
+}
+
+void exercise_snapshot_api() {
+  std::vector<int64_t> rows(kNodes * 3);
+  for (int64_t i = 0; i < kNodes; ++i) {
+    rows[i * 3 + 0] = 16000 + (i % 7) * 4000;
+    rows[i * 3 + 1] = (int64_t{64} << 30) + (i % 5) * (int64_t{16} << 30);
+    rows[i * 3 + 2] = (i % 11 == 0) ? 8000 : 0;
+  }
+  void* snap = snap_create(kNodes);
+  check(snap != nullptr, "snap_create");
+  check(snap_load(snap, rows.data(), kNodes) == 1, "snap_load");
+  check(snap_size(snap) == kNodes, "snap_size");
+  // delta rows are [count, 3]; rows 1+2 cancel out on node 5, row 3
+  // targets an out-of-range index and must be ignored
+  std::vector<int32_t> idx = {0, 5, 5, static_cast<int32_t>(kNodes)};
+  std::vector<int64_t> deltas = {
+      1000, 0,     0,   // node 0: -1000 cpu
+      2000, 1 << 20, 0, // node 5: reserve …
+      -2000, -(1 << 20), 0,  // … and release (cancels)
+      77,   99,    11,  // ignored (index out of range)
+  };
+  snap_apply_deltas(snap, idx.data(), deltas.data(),
+                    static_cast<int64_t>(idx.size()));
+  std::vector<int64_t> out(kNodes * 3, 0);
+  snap_read(snap, out.data());
+  check(out[0] == rows[0] - 1000, "snap delta applied");
+  check(out[5 * 3] == rows[5 * 3], "snap cancelled delta");
+  check(snap_rows_diff(rows.data(), rows.data(), kNodes) == -1,
+        "snap_rows_diff equal");
+  check(snap_rows_diff(rows.data(), out.data(), kNodes) == 0,
+        "snap_rows_diff first-diff index");
+  std::vector<int64_t> demands = {2000, int64_t{8} << 30, 0,
+                                  4000, int64_t{16} << 30, 0};
+  std::vector<int32_t> out_avail(kNodes * 3, 0);
+  std::vector<int32_t> out_dem(2 * 3, 0);
+  std::vector<int64_t> out_scale(3, 1);
+  check(snap_scale_int32(snap, demands.data(), 2, kNodes, out_avail.data(),
+                         out_dem.data(), out_scale.data()) == 1,
+        "snap_scale_int32");
+  snap_destroy(snap);
+}
+
+void exercise_diagnostics(const Fixture& fx) {
+  std::vector<uint8_t> blockers(kApps, 0);
+  std::vector<int64_t> info(12, 0);
+  check(fifo_explain_queue(kNodes, kApps, fx.avail.data(), fx.rank.data(),
+                           fx.exec_ok.data(), fx.apps8.data(), /*policy=*/0,
+                           /*target=*/kApps - 1, blockers.data(),
+                           info.data()) == 1,
+        "fifo_explain_queue");
+  std::vector<int32_t> shapes = {2, 8, 0, 4, 16, 0};
+  std::vector<int64_t> headroom(1, 0), usable(3, 0), probes(1, 0);
+  check(fifo_probe_headroom(kNodes, fx.avail.data(), fx.rank.data(),
+                            fx.exec_ok.data(), 1, shapes.data(),
+                            /*k_max=*/64, headroom.data(), usable.data(),
+                            probes.data()) == 1,
+        "fifo_probe_headroom");
+  std::vector<int64_t> frag(12, 0);
+  check(fifo_frag_report(kNodes, fx.avail.data(), fx.exec_ok.data(),
+                         frag.data()) == 1,
+        "fifo_frag_report");
+}
+
+}  // namespace
+
+int main() {
+  Fixture fx;
+
+  // correctness anchor: the first app of the fixture must fit cold
+  Verdict expect = cold_solve(fx, kApps, 0);
+  check(expect.feas[0] == 1, "fixture head app feasible");
+
+  // phase 1: concurrent stateless solves (shared inputs)
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < 4; ++t) {
+      ts.emplace_back(stateless_worker, &fx, &expect, 25);
+    }
+    for (auto& t : ts) t.join();
+  }
+
+  // phase 2: concurrent sessions, each with its own 4-worker SweepPool,
+  // across all three policies, warm≡cold checked per solve
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < 3; ++t) {
+      ts.emplace_back(session_worker, &fx, /*policy=*/t, 20);
+    }
+    for (auto& t : ts) t.join();
+  }
+
+  // phase 3: create/load/solve/destroy churn
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < 4; ++t) {
+      ts.emplace_back(churn_worker, &fx, 10);
+    }
+    for (auto& t : ts) t.join();
+  }
+
+  // phase 4: snapshot + diagnostics APIs (ASan/UBSan value)
+  exercise_snapshot_api();
+  exercise_diagnostics(fx);
+
+  if (failures != 0) {
+    std::fprintf(stderr, "concurrency_smoke: %d failure(s)\n", failures);
+    return 1;
+  }
+  std::printf("concurrency_smoke: OK\n");
+  return 0;
+}

@@ -290,6 +290,69 @@ def test_journal_replay_exactly_once_across_failover(tmp_path):
             pass
 
 
+def test_failover_reconcile_runs_clean_under_race_detectors(monkeypatch):
+    """Leader failover + reconcile under BOTH race detectors (lockset +
+    happens-before vector clocks): the new instance's lister seeding,
+    journal handling and soft-reservation rebuild run threads the chaos
+    scenario does not (boot-time replay against live informers), so the
+    failover path gets its own zero-races gate.  The journal's
+    persist→replay happens-before edge (record → pending) is exactly
+    what keeps the replay ordering visible to the vector clocks."""
+    from k8s_spark_scheduler_tpu.analysis import racecheck
+    from k8s_spark_scheduler_tpu.config import Install
+    from k8s_spark_scheduler_tpu.server.wiring import init_server_with_clients
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    monkeypatch.setenv(racecheck.ENV_FLAG, "1")
+    racecheck.disable()
+    h = None
+    new_server = None
+    try:
+        h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+        h.new_node("n1")
+        h.new_node("n2")
+        nodes = ["n1", "n2"]
+        for p in h.static_allocation_spark_pods("app-rc", 2):
+            h.assert_success(h.schedule(p, nodes))
+        h.wait_quiesced()
+        h.server.stop()
+        # failover: a fresh instance seeds from listers and reconciles
+        new_server = init_server_with_clients(
+            h.api,
+            Install(fifo=True, binpack_algo="tpu-batch"),
+            demand_poll_interval=0.02,
+        )
+        assert (
+            new_server.resource_reservation_cache.get("default", "app-rc")
+            is not None
+        )
+        probe = Harness.static_allocation_spark_pods("probe-rc", 1)
+        h.api.create(probe[0])
+        result = new_server.extender.predicate(
+            ExtenderArgs(pod=probe[0], node_names=nodes)
+        )
+        assert result.node_names
+    finally:
+        detector = racecheck.disable()
+        if new_server is not None:
+            try:
+                new_server.stop()
+            except Exception:
+                pass
+        if h is not None:
+            try:
+                h.close()
+            except Exception:
+                pass
+    assert detector is not None, "the harness never enabled the detector"
+    assert detector._instances, "no guarded instances were instrumented"
+    assert detector.races == [], "\n".join(detector.report_lines())
+    assert detector.hb_races == [], "\n".join(detector.report_lines())
+    assert detector.lock_order_violations == [], "\n".join(
+        detector.report_lines()
+    )
+
+
 def test_leader_failover_new_instance_rebuilds_state():
     """The checkpoint/resume contract (SURVEY §5): durable state is the
     reservation/demand objects at the API server; a NEW scheduler

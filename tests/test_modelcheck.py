@@ -1,0 +1,402 @@
+"""Model checker acceptance: the seeded-bug corpus (a lost wakeup, a
+TOCTOU on a feed-sequence warm check, an unlock-before-publish
+reordering) must each be caught within a bounded schedule budget with a
+deterministic counterexample (same seed ⇒ same failing schedule), the
+correct twins must survive full exploration, and the real-component
+scenario corpus must run clean at a tier-1 budget (CI's model-check
+lane re-runs it at ≥1k schedules per scenario)."""
+
+import threading
+
+import pytest
+
+from k8s_spark_scheduler_tpu.analysis import modelcheck as mc
+from k8s_spark_scheduler_tpu.analysis import racecheck
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+from k8s_spark_scheduler_tpu.analysis.mcscenarios import corpus
+
+_BUDGET = 300  # schedules; each seeded bug must fall well inside this
+
+
+# ---------------------------------------------------------------------------
+# seeded bug 1: lost wakeup (check-then-wait against a memoryless pulse)
+# ---------------------------------------------------------------------------
+
+
+def _lost_wakeup_scenario(buggy: bool) -> mc.Scenario:
+    class State:
+        def __init__(self):
+            self.pulse = mc.CoopPulse()
+            self.event = mc.CoopEvent()
+            self.ready = False
+
+    def setup():
+        return State()
+
+    def threads(st):
+        def producer():
+            st.ready = True
+            mc.checkpoint("produced")
+            st.pulse.notify()
+            st.event.set()
+
+        def consumer():
+            ready = st.ready
+            mc.checkpoint("checked")  # the check→wait window
+            if not ready:
+                if buggy:
+                    # a pulse carries no memory: a notify that fired in
+                    # the window is lost and this waits forever
+                    st.pulse.wait()
+                else:
+                    # sticky event: set-before-wait still wakes
+                    st.event.wait()
+
+        return [("producer", producer), ("consumer", consumer)]
+
+    return mc.Scenario(
+        name="lost-wakeup" + ("-buggy" if buggy else "-fixed"),
+        setup=setup, threads=threads,
+    )
+
+
+def test_lost_wakeup_is_caught_as_deadlock():
+    res = mc.explore(_lost_wakeup_scenario(True), max_schedules=_BUDGET, seed=3)
+    assert res.violation is not None, "lost wakeup survived exploration"
+    assert "deadlock" in res.violation.reason
+    assert "pulse-wait" in res.violation.reason
+    assert res.schedules <= _BUDGET
+
+
+def test_lost_wakeup_fixed_twin_is_clean():
+    res = mc.explore(_lost_wakeup_scenario(False), max_schedules=_BUDGET, seed=3)
+    assert res.ok, str(res.violation)
+
+
+# ---------------------------------------------------------------------------
+# seeded bug 2: TOCTOU on a feed-sequence warm check
+# ---------------------------------------------------------------------------
+
+
+def _toctou_scenario(buggy: bool) -> mc.Scenario:
+    """A versioned mirror: (data, seq) move in lockstep under one lock.
+    The buggy reader checks the sequence in one lock hold and reads the
+    data in another — the delta-solve warm check done wrong."""
+
+    @guarded_by("_lock", "data", "seq")
+    class Mirror:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.data = 0
+            self.seq = 0
+
+        def mutate(self):
+            with self._lock:
+                racecheck.note_access(self, "data")
+                self.data += 1
+                self.seq += 1
+
+        def read_pair(self):
+            with self._lock:
+                return self.data, self.seq
+
+        def read_seq(self):
+            with self._lock:
+                return self.seq
+
+    class State:
+        def __init__(self):
+            self.mirror = Mirror()
+
+    def setup():
+        return State()
+
+    def threads(st):
+        def writer():
+            st.mirror.mutate()
+
+        def warm_reader():
+            data1, seq1 = st.mirror.read_pair()
+            mc.checkpoint("warm-window")
+            if buggy:
+                # TOCTOU: seq checked in one critical section …
+                seq2 = st.mirror.read_seq()
+                mc.checkpoint("between-check-and-use")
+                if seq2 == seq1:
+                    # … data used from another: the writer can slip in
+                    data2, _ = st.mirror.read_pair()
+                    assert data2 == data1, (
+                        f"warm check unsound: seq {seq1} unchanged but "
+                        f"data {data1}→{data2}"
+                    )
+            else:
+                data2, seq2 = st.mirror.read_pair()
+                if seq2 == seq1:
+                    assert data2 == data1
+
+        return [("writer", writer), ("reader", warm_reader)]
+
+    return mc.Scenario(
+        name="feed-toctou" + ("-buggy" if buggy else "-fixed"),
+        setup=setup, threads=threads,
+    )
+
+
+def test_feed_seq_toctou_is_caught():
+    res = mc.explore(_toctou_scenario(True), max_schedules=_BUDGET, seed=5)
+    assert res.violation is not None, "TOCTOU survived exploration"
+    assert "warm check unsound" in res.violation.reason
+    assert res.schedules <= _BUDGET
+
+
+def test_feed_seq_toctou_fixed_twin_is_clean():
+    res = mc.explore(_toctou_scenario(False), max_schedules=_BUDGET, seed=5)
+    assert res.ok, str(res.violation)
+
+
+# ---------------------------------------------------------------------------
+# seeded bug 3: unlock-before-publish reordering
+# ---------------------------------------------------------------------------
+
+
+def _publish_reorder_scenario(buggy: bool) -> mc.Scenario:
+    @guarded_by("_lock", "items", "seq")
+    class Ring:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []
+            self.seq = 0
+
+        def publish_buggy(self, x):
+            # BUG: the sequence is published in one critical section,
+            # the item lands in a second — a reader between them sees
+            # seq=N with N-1 items
+            with self._lock:
+                racecheck.note_access(self, "seq")
+                self.seq += 1
+            with self._lock:
+                racecheck.note_access(self, "items")
+                self.items.append(x)
+
+        def publish_ok(self, x):
+            with self._lock:
+                racecheck.note_access(self, "items")
+                self.items.append(x)
+                self.seq += 1
+
+        def read(self):
+            with self._lock:
+                return self.seq, len(self.items)
+
+    class State:
+        def __init__(self):
+            self.ring = Ring()
+
+    def setup():
+        return State()
+
+    def threads(st):
+        def writer():
+            if buggy:
+                st.ring.publish_buggy("a")
+            else:
+                st.ring.publish_ok("a")
+
+        def reader():
+            seq, n = st.ring.read()
+            assert n >= seq, f"seq {seq} published but only {n} items"
+
+        return [("writer", writer), ("reader", reader)]
+
+    return mc.Scenario(
+        name="publish-reorder" + ("-buggy" if buggy else "-fixed"),
+        setup=setup, threads=threads,
+    )
+
+
+def test_unlock_before_publish_reorder_is_caught():
+    res = mc.explore(_publish_reorder_scenario(True), max_schedules=_BUDGET,
+                     seed=11)
+    assert res.violation is not None, "reordering survived exploration"
+    assert "published but only" in res.violation.reason
+    assert res.schedules <= _BUDGET
+
+
+def test_unlock_before_publish_fixed_twin_is_clean():
+    res = mc.explore(_publish_reorder_scenario(False), max_schedules=_BUDGET,
+                     seed=11)
+    assert res.ok, str(res.violation)
+
+
+# ---------------------------------------------------------------------------
+# counterexample determinism + replay
+# ---------------------------------------------------------------------------
+
+
+def test_counterexamples_are_deterministic_and_replayable():
+    for factory, seed in (
+        (lambda: _lost_wakeup_scenario(True), 3),
+        (lambda: _toctou_scenario(True), 5),
+        (lambda: _publish_reorder_scenario(True), 11),
+    ):
+        a = mc.explore(factory(), max_schedules=_BUDGET, seed=seed)
+        b = mc.explore(factory(), max_schedules=_BUDGET, seed=seed)
+        assert a.violation is not None and b.violation is not None
+        assert a.violation.schedule == b.violation.schedule
+        assert a.violation.schedule_index == b.violation.schedule_index
+        # the recorded schedule replays to the same failure
+        replayed = mc.replay(factory(), a.violation.schedule)
+        assert replayed is not None
+        assert replayed.schedule == a.violation.schedule
+
+
+def test_counterexample_carries_a_trace():
+    res = mc.explore(_publish_reorder_scenario(True), max_schedules=_BUDGET,
+                     seed=11)
+    assert res.violation is not None
+    text = str(res.violation)
+    assert "schedule:" in text
+    assert any("run writer" in line for line in res.violation.trace)
+    assert any("run reader" in line for line in res.violation.trace)
+
+
+# ---------------------------------------------------------------------------
+# a race on an explored schedule fails the scenario
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_level_race_detection_fires():
+    @guarded_by("_lock", "count")
+    class Racy:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def bump(self):
+            racecheck.note_access(self, "count")
+            self.count += 1
+            mc.checkpoint("unlocked-bump")
+
+    def setup():
+        return Racy()
+
+    def threads(racy):
+        return [("a", racy.bump), ("b", racy.bump)]
+
+    res = mc.explore(mc.Scenario(name="racy", setup=setup, threads=threads),
+                     max_schedules=50, seed=1)
+    assert res.violation is not None
+    assert "race detected" in res.violation.reason
+
+
+# ---------------------------------------------------------------------------
+# the real-component corpus, tier-1 budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("scenario", corpus(), ids=lambda s: s.name)
+def test_component_corpus_clean_at_tier1_budget(scenario):
+    res = mc.explore(scenario, max_schedules=120, seed=7)
+    assert res.ok, str(res.violation)
+    assert res.schedules == 120
+    assert res.decisions > 0
+
+
+def test_cli_via_python_dash_m_subprocess():
+    """Regression: ``python -m …analysis.modelcheck`` loads modelcheck
+    twice (as __main__ and canonically via mcscenarios); with a
+    per-copy TLS registry, CoopEvent consulted the wrong copy, fell
+    back to a REAL blocking wait, and every schedule that parked the
+    waiter burned the stuck-schedule guard — the CI model-check lane's
+    exact invocation failed on correct code.  The registry now lives on
+    racecheck (loaded once), so the real CLI must pass quickly."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "k8s_spark_scheduler_tpu.analysis.modelcheck",
+         "--schedules", "30", "--seed", "7",
+         "--scenario", "changefeed-publish-wakeup"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=repo_root,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok" in proc.stdout
+
+
+def test_cli_runs_one_scenario(capsys):
+    from k8s_spark_scheduler_tpu.analysis.modelcheck import main
+
+    rc = main(["--schedules", "40", "--seed", "7",
+               "--scenario", "admission-gate"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "admission-gate" in out and "ok" in out
+
+
+def test_lock_taking_invariant_does_not_mask_races():
+    """The orchestrator runs invariants under a quarantine: its lock
+    acquire/releases must NOT thread scenario threads' vector clocks
+    through component locks (regression: an invariant that took two
+    locks used to fabricate a happens-before edge between otherwise
+    unordered scenario accesses, silently hiding the race)."""
+
+    @guarded_by("_lock", "value")
+    class Holder:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.other = threading.Lock()
+            self.value = 0
+
+    def setup():
+        h = Holder()
+        racecheck.track_extra_lock(h, "other")
+        return h
+
+    def threads(h):
+        def writer():
+            racecheck.note_access(h, "value")  # unguarded write
+            h.value = 1  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+            with h._lock:
+                pass
+
+        def reader():
+            with h.other:
+                pass
+            racecheck.note_access(h, "value", write=False)  # unguarded read
+
+        return [("writer", writer), ("reader", reader)]
+
+    def lock_taking_invariant(h):
+        # touches BOTH locks — exactly the clock-bridging shape
+        with h._lock:
+            pass
+        with h.other:
+            pass
+
+    sc = mc.Scenario(
+        name="invariant-quarantine", setup=setup, threads=threads,
+        invariant=lock_taking_invariant,
+    )
+    res = mc.explore(sc, max_schedules=100, seed=2)
+    assert res.violation is not None, (
+        "the unguarded write/read race was masked by the invariant's "
+        "lock traffic"
+    )
+    assert "race detected" in res.violation.reason
+
+
+def test_detector_restored_after_runs():
+    # explore() must restore whatever detector was active before it ran
+    prior = racecheck.enable(racecheck.RaceDetector())
+    try:
+        mc.explore(_lost_wakeup_scenario(False), max_schedules=10, seed=1)
+        assert racecheck.get() is prior
+    finally:
+        racecheck.disable()

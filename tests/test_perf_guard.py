@@ -392,6 +392,50 @@ def test_capacity_sampler_overhead_within_budget():
         h.close()
 
 
+def test_racecheck_disabled_overhead_within_budget():
+    """The race-detector checkpoints stay in the hot paths permanently,
+    so their disabled cost is a contract: one module-attribute read and
+    a None check.  Pinned relative to an equivalent no-op call through
+    the same calling convention (load-robust), plus an absolute
+    per-call ceiling so the relative bound can't hide a regression to
+    microseconds."""
+    from k8s_spark_scheduler_tpu.analysis import racecheck
+
+    assert not racecheck.active(), "detector must be disabled for this guard"
+
+    class Owner:
+        pass
+
+    owner = Owner()
+    n = 200_000
+
+    def noop(obj, field, write=True):
+        d = None
+        if d is not None:  # same shape: read + None check + branch
+            raise AssertionError
+
+    def run_noop():
+        for _ in range(n):
+            noop(owner, "f")
+
+    def run_note_access():
+        for _ in range(n):
+            racecheck.note_access(owner, "f")
+
+    run_noop(); run_note_access()  # warm
+    base_s = _best_of(run_noop)
+    note_s = _best_of(run_note_access)
+    per_call_us = note_s / n * 1e6
+    budget_s = base_s * 4.0 + n * 1.5e-6  # 4x a no-op call + 1.5µs/call
+    assert note_s <= budget_s, (
+        f"disabled note_access {per_call_us:.3f}µs/call exceeds budget "
+        f"(no-op baseline {base_s / n * 1e6:.3f}µs/call)"
+    )
+    # hard ceiling independent of the baseline: the disabled path must
+    # never grow real work
+    assert per_call_us < 5.0, f"disabled note_access {per_call_us:.3f}µs/call"
+
+
 def test_predicate_latency_with_tracing_within_budget():
     from k8s_spark_scheduler_tpu.testing.harness import Harness
 

@@ -1,8 +1,11 @@
-"""Runtime lockset race detector: a seeded race in a fixture class MUST
-be caught, the lock-disciplined twin must stay clean, and lock-order
-cycles must be recorded.  The 'real codebase runs clean' half of the
-acceptance lives in test_sim_chaos.py (detector active under fault
-injection)."""
+"""Runtime race detectors (lockset + happens-before vector clocks): a
+seeded race in a fixture class MUST be caught by both, the
+lock-disciplined twin must stay clean, lock-order cycles must be
+recorded, and the two detectors must disagree in exactly the documented
+directions — a channel-synchronized handoff is lockset noise but
+HB-clean, an unsynchronized write→read pair is lockset-silent but an HB
+race.  The 'real codebase runs clean' half of the acceptance lives in
+test_sim_chaos.py (detector active under fault injection)."""
 
 import threading
 
@@ -170,3 +173,222 @@ def test_report_lines_roundtrip(detector):
     _hammer(racy, threads=2)
     lines = detector.report_lines()
     assert any("unprotected shared write" in line for line in lines)
+
+
+# -- happens-before (vector clock) detector -----------------------------------
+
+
+def test_seeded_race_also_caught_by_hb_and_safe_twin_hb_clean(detector):
+    racy, safe = RacyCounter(), SafeCounter()
+    _hammer(racy, safe)
+    assert any("RacyCounter" in r.owner for r in detector.hb_races), (
+        "the vector-clock detector missed the seeded race"
+    )
+    assert not any("SafeCounter" in r.owner for r in detector.hb_races), (
+        "lock-ordered writes misreported as an HB race"
+    )
+
+
+def test_unsynchronized_write_read_is_hb_race_but_lockset_silent(detector):
+    """Eraser only reports on shared-MODIFIED, so a single writer with an
+    unsynchronized reader is invisible to the lockset; the vector clocks
+    see the unordered pair — the 'missed ordering race' class."""
+    holder = RacyCounter()
+    ready = threading.Event()  # real-time ordering, NO happens-before edge
+
+    def writer():
+        racecheck.note_access(holder, "counts", write=True)
+        holder.counts["k"] = 1  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+        ready.set()
+
+    def reader():
+        ready.wait()
+        racecheck.note_access(holder, "counts", write=False)
+
+    t1 = threading.Thread(target=writer, name="w")
+    t2 = threading.Thread(target=reader, name="r")
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert detector.races == [], "lockset should not fire on write→read"
+    assert len(detector.hb_races) == 1
+    report = detector.hb_races[0]
+    assert {report.first_write, report.second_write} == {True, False}
+    assert "unordered with" in str(report)
+
+
+def test_channel_handoff_is_hb_clean_but_lockset_noise(detector):
+    """A publish/observe-synchronized handoff: two threads write the
+    field with an empty lockset (Eraser false-positives) but the channel
+    edge orders them (HB stays clean) — the 'handoff noise' class."""
+    holder = RacyCounter()
+    handed = threading.Event()
+
+    def first_owner():
+        racecheck.note_access(holder, "counts")
+        holder.counts["k"] = 1  # schedlint: disable=LK001 -- seeded handoff fixture: ownership transfer, no common lock
+        racecheck.hb_publish("handoff")
+        handed.set()
+
+    def second_owner():
+        handed.wait()
+        racecheck.hb_observe("handoff")
+        racecheck.note_access(holder, "counts")
+        holder.counts["k"] = 2  # schedlint: disable=LK001 -- seeded handoff fixture: ownership transfer, no common lock
+
+    t1 = threading.Thread(target=first_owner, name="owner-1")
+    t2 = threading.Thread(target=second_owner, name="owner-2")
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert detector.hb_races == [], "\n".join(detector.report_lines())
+    assert len(detector.races) == 1, (
+        "the lockset is EXPECTED to false-positive here — if it stopped, "
+        "the two detectors no longer bracket each other"
+    )
+
+
+def test_thread_start_join_edges_order_accesses(detector):
+    """Parent-before-start and child-before-join accesses are ordered by
+    the fork/join edges alone — no lock, no channel."""
+    holder = RacyCounter()
+    racecheck.note_access(holder, "counts")
+    holder.counts["parent"] = 1  # schedlint: disable=LK001 -- fork/join-ordered fixture: edges under test
+
+    def child():
+        racecheck.note_access(holder, "counts")
+        holder.counts["child"] = 1  # schedlint: disable=LK001 -- fork/join-ordered fixture: edges under test
+
+    t = threading.Thread(target=child, name="child")
+    t.start()
+    t.join()
+    racecheck.note_access(holder, "counts")
+    holder.counts["parent"] = 2  # schedlint: disable=LK001 -- fork/join-ordered fixture: edges under test
+    assert detector.hb_races == [], "\n".join(detector.report_lines())
+
+
+def test_missing_join_edge_is_hb_race(detector):
+    """The same parent/child shape WITHOUT the join edge: the parent's
+    second write races the child's."""
+    holder = RacyCounter()
+    done = threading.Event()
+
+    def child():
+        racecheck.note_access(holder, "counts")
+        holder.counts["child"] = 1  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+        done.set()
+
+    t = threading.Thread(target=child, name="child")
+    t.start()
+    done.wait()  # real-time ordering only — no HB edge
+    racecheck.note_access(holder, "counts")
+    holder.counts["parent"] = 2  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+    t.join()
+    assert len(detector.hb_races) == 1
+    report = detector.hb_races[0]
+    assert report.first_site is not None and report.second_site is not None
+    assert "test_racecheck" in str(report.first_site[0])
+
+
+def test_hb_report_carries_both_access_sites(detector):
+    racy = RacyCounter()
+    _hammer(racy, threads=2, iters=100)
+    assert detector.hb_races
+    report = detector.hb_races[0]
+    text = str(report)
+    # both sites name this file and the mutating function
+    assert text.count("test_racecheck.py") == 2
+    assert "bump" in text
+
+
+def test_clean_includes_hb_races(detector):
+    detector.hb_races.append(
+        racecheck.HbRaceReport(
+            owner="X#0", field="f",
+            first_thread="a", first_site=None, first_write=True,
+            second_thread="b", second_site=None, second_write=True,
+        )
+    )
+    assert not detector.clean()
+    assert any("happens-before race" in line for line in detector.report_lines())
+
+
+def test_unjoined_threads_do_not_leak_fork_clocks(detector):
+    """Thread.start stashes the parent's clock for the child; a child
+    that never touches the detector and is never joined must not pin
+    that copy forever (one such thread per HTTP connection in soaks)."""
+    import gc
+
+    def spawn_and_drop():
+        threads = [
+            threading.Thread(target=lambda: None, name=f"idle-{i}")
+            for i in range(20)
+        ]
+        for t in threads:
+            t.start()
+        deadline = 50
+        while any(t.is_alive() for t in threads) and deadline:
+            threading.Event().wait(0.02)
+            deadline -= 1
+        # never joined: the weak keying alone must reclaim the clocks
+
+    spawn_and_drop()
+    gc.collect()
+    assert len(detector._fork_vcs) == 0, (
+        f"{len(detector._fork_vcs)} fork clocks pinned for dead threads"
+    )
+
+
+def test_failed_queue_handoff_plants_no_edge(detector):
+    """hb_snapshot edges are carried inside the handed-off item: a
+    snapshot that is dropped (Full shard) must not order the producer
+    before any later consumer."""
+    holder = RacyCounter()
+    handed = threading.Event()
+
+    def producer():
+        racecheck.note_access(holder, "counts")
+        holder.counts["k"] = 1  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+        snapshot = racecheck.hb_snapshot()
+        del snapshot  # the put failed: snapshot dropped, no hb_join ever
+        handed.set()
+
+    def consumer():
+        handed.wait()
+        racecheck.note_access(holder, "counts")
+        holder.counts["k"] = 2  # schedlint: disable=LK001 -- seeded-race fixture: the bug under test
+
+    t1 = threading.Thread(target=producer, name="producer")
+    t2 = threading.Thread(target=consumer, name="consumer")
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert len(detector.hb_races) == 1, (
+        "a dropped handoff snapshot must leave the accesses unordered"
+    )
+
+
+def test_successful_handoff_snapshot_orders_consumer(detector):
+    holder = RacyCounter()
+    handed = threading.Event()
+    box = {}
+
+    def producer():
+        racecheck.note_access(holder, "counts")
+        holder.counts["k"] = 1  # schedlint: disable=LK001 -- seeded handoff fixture: ownership transfer, no common lock
+        box["snap"] = racecheck.hb_snapshot()
+        handed.set()
+
+    def consumer():
+        handed.wait()
+        racecheck.hb_join(box["snap"])
+        racecheck.note_access(holder, "counts")
+        holder.counts["k"] = 2  # schedlint: disable=LK001 -- seeded handoff fixture: ownership transfer, no common lock
+
+    t1 = threading.Thread(target=producer, name="producer")
+    t2 = threading.Thread(target=consumer, name="consumer")
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert detector.hb_races == [], "\n".join(detector.report_lines())
+
+
+def test_lock_release_acquire_is_an_hb_edge(detector):
+    """Two threads writing under DIFFERENT critical sections of the SAME
+    lock are ordered — the HB detector must not fire even though the
+    accesses interleave arbitrarily."""
+    safe = SafeCounter()
+    _hammer(safe, threads=4, iters=200)
+    assert detector.hb_races == [], "\n".join(detector.report_lines())

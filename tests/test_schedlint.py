@@ -43,7 +43,8 @@ def test_cli_list_rules_covers_all_families(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("TS001", "TS002", "TS003", "DT001", "LK001", "LK002",
-                 "LK003", "JX001", "JX002", "JX003", "JX004", "PR001"):
+                 "LK003", "LK004", "JX001", "JX002", "JX003", "JX004",
+                 "NA001", "NA002", "PR001"):
         assert rule in out
 
 
@@ -232,6 +233,224 @@ class C:
     findings = _analyze_snippet(tmp_path, src)
     assert [f.rule for f in findings] == ["LK001"]
     assert findings[0].symbol == "C.bad"
+
+
+def test_lk004_flags_undeclared_lock_with_mutating_methods(tmp_path):
+    src = """
+import threading
+
+class HasLockNoDecl:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def mutate(self, k):
+        with self._lock:
+            self._state[k] = 1
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["LK004"]
+    assert findings[0].symbol == "HasLockNoDecl"
+
+
+def test_lk004_quiet_cases(tmp_path):
+    src = """
+import threading
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+
+@guarded_by("_lock", "_state")
+class Declared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def mutate(self, k):
+        with self._lock:
+            self._state[k] = 1
+
+class LockButReadOnly:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._state = {}
+
+    def peek(self, k):
+        with self._lock:
+            return self._state.get(k)
+
+class MutatesButNoLock:
+    def __init__(self):
+        self._state = {}
+
+    def mutate(self, k):
+        self._state[k] = 1
+"""
+    assert _analyze_snippet(tmp_path, src) == []
+
+
+def test_lk004_pragma_on_class_line(tmp_path):
+    src = """
+import threading
+
+class Serializer:  # schedlint: disable=LK004 -- pure serializer lock, guards flow not fields
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+"""
+    assert _analyze_snippet(tmp_path, src) == []
+
+
+def test_na001_flags_native_call_under_guarded_lock(tmp_path):
+    src = """
+import threading
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+from k8s_spark_scheduler_tpu.native import rows_equal
+
+@guarded_by("_lock", "_basis")
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._basis = None
+
+    def bad(self, a, b):
+        with self._lock:
+            return rows_equal(a, b)
+
+    def good(self, a, b):
+        with self._lock:
+            basis = self._basis
+        return rows_equal(a, basis)
+
+    def gil_safe_ok(self, sess):
+        with self._lock:
+            return sess.native.mem_bytes()
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["NA001"]
+    assert findings[0].symbol == "Engine.bad"
+    assert "GIL" in findings[0].message
+
+
+def test_na001_reports_nested_call_exactly_once(tmp_path):
+    # a call buried two blocks deep under the lock must yield ONE
+    # finding, not one per nesting level (regression: the walker used
+    # to both ast.walk the statement and recurse into its blocks)
+    src = """
+import threading
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+from k8s_spark_scheduler_tpu.native import rows_equal
+
+@guarded_by("_lock", "_basis")
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._basis = None
+
+    def bad(self, a, b):
+        with self._lock:
+            if a is not None:
+                try:
+                    return rows_equal(a, b)
+                finally:
+                    pass
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["NA001"]
+
+
+def test_na001_ignores_deferred_nested_functions(tmp_path):
+    # a function DEFINED under the lock runs later, lock-free: its
+    # native calls are not in-lock crossings
+    src = """
+import threading
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+from k8s_spark_scheduler_tpu.native import rows_equal
+
+@guarded_by("_lock", "_cb")
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cb = None
+
+    def ok(self, a, b):
+        with self._lock:
+            def later():
+                return rows_equal(a, b)
+            self._cb = later
+"""
+    assert _analyze_snippet(tmp_path, src) == []
+
+
+def test_na001_flags_attribute_chain_receivers(tmp_path):
+    src = """
+import threading
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+
+@guarded_by("_lock", "_sessions")
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}
+
+    def bad(self, key):
+        with self._lock:
+            return self._sessions[key].native.solve(None)
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["NA001"]
+
+
+def test_na001_and_lk001_see_inside_match_arms(tmp_path):
+    # `match` case bodies are block statements too: a native call under
+    # the lock, or a guarded mutation outside it, must not hide there
+    src = """
+import threading
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+from k8s_spark_scheduler_tpu.native import rows_equal
+
+@guarded_by("_lock", "_state")
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+
+    def na_in_match(self, kind, a, b):
+        with self._lock:
+            match kind:
+                case "eq":
+                    return rows_equal(a, b)
+        return None
+
+    def lk_in_match(self, kind, k):
+        match kind:
+            case "set":
+                self._state[k] = 1
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert sorted(f.rule for f in findings) == ["LK001", "NA001"]
+
+
+def test_na002_flags_raw_handle_outside_native(tmp_path):
+    src = """
+def leak(sess):
+    return sess._handle
+"""
+    findings = _analyze_snippet(tmp_path, src)
+    assert [f.rule for f in findings] == ["NA002"]
+    assert "lifetime" in findings[0].message
+
+
+def test_na002_allows_native_package_files(tmp_path):
+    native_dir = tmp_path / "native"
+    native_dir.mkdir()
+    f = native_dir / "binding.py"
+    f.write_text("def close(self):\n    return self._handle\n")
+    config = AnalysisConfig(use_default_allowlist=False)
+    findings = analyze_paths([str(f)], config=config, root=str(tmp_path))
+    assert findings == []
 
 
 def test_jx001_static_args_not_flagged(tmp_path):

@@ -130,6 +130,9 @@ def test_chaos_scenario_runs_clean_under_race_detector(monkeypatch):
     assert detector is not None, "the sim runner never enabled the detector"
     assert detector._instances, "no guarded instances were instrumented"
     assert detector.races == [], "\n".join(detector.report_lines())
+    # the vector-clock detector runs alongside the lockset over the same
+    # checkpoints: zero happens-before races either
+    assert detector.hb_races == [], "\n".join(detector.report_lines())
     assert detector.lock_order_violations == [], "\n".join(detector.report_lines())
     assert detector.clean()
 
@@ -215,7 +218,16 @@ def test_chaos_with_delta_engine_runs_clean_under_race_detector(monkeypatch):
     # the capacity sampler's ring/stats are guarded shared state on the
     # sim's sampling path: instrumented and race-free too
     assert "CapacitySampler" in tracked, tracked
+    # PR 9's LK004 sweep promoted the remaining locked classes into the
+    # registry: the tensor mirror, the informers, the metrics registry
+    # and the sim clock are all under both detectors now
+    assert "TensorSnapshotCache" in tracked, tracked
+    assert "Informer" in tracked, tracked
+    assert "MetricsRegistry" in tracked, tracked
+    # (VirtualClock is constructed before the runner enables the
+    # detector, so it is deliberately skipped — see racecheck docstring)
     assert detector.races == [], "\n".join(detector.report_lines())
+    assert detector.hb_races == [], "\n".join(detector.report_lines())
     assert detector.lock_order_violations == [], "\n".join(
         detector.report_lines()
     )
