@@ -1132,6 +1132,11 @@ def main() -> None:
         if ds is not None:
             headline["warm_solve_p50_ms"] = ds["warm_p50_ms"]
             headline["cold_solve_p50_ms"] = ds["cold_p50_ms"]
+        # contention-observatory evidence: how much of the request the
+        # decomposition explains, and which segment dominates
+        if "criticalpath_coverage_p50" in e2e:
+            headline["criticalpath_coverage_p50"] = e2e["criticalpath_coverage_p50"]
+            headline["criticalpath_dominant"] = e2e.get("criticalpath_dominant")
     else:
         # no request-level measurement: the solver lane stands, under
         # its own honest p99_queue_solve_… name
@@ -1466,6 +1471,55 @@ def _config5_e2e(force_cpu: bool = True) -> dict | None:
             stats["resume_depth_p50"] = es["resume_depth_p50"]
             stats["deltasolve_sessions"] = es["sessions"]
             stats["deltasolve_misses"] = es["misses"]
+        # contention-observatory scrape: the critical-path decomposition
+        # of the probes just measured (acceptance: named segments must
+        # reconstruct the server-side request) plus the predicate lock's
+        # wait/hold picture — one more lane in the durable artifact
+        try:
+            def get_json(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}{path}", timeout=30
+                ) as resp:
+                    return _json.loads(resp.read())
+
+            cp = get_json("/debug/criticalpath")
+            con = get_json("/debug/contention?lock=extender.predicate")
+            seg = cp.get("segments", {})
+            lane = {
+                "window": cp.get("window", 0),
+                "total_p99_ms": cp.get("totalMs", {}).get("p99", 0.0),
+                "coverage_p50": cp.get("coverage", {}).get("p50", 0.0),
+                "gate_queue_p99_ms": seg.get("gate-queue", {}).get("p99Ms", 0.0),
+                "lock_wait_p99_ms": seg.get("lock-wait", {}).get("p99Ms", 0.0),
+                "serde_p99_ms": seg.get("serde", {}).get("p99Ms", 0.0),
+                "solve_p99_ms": seg.get("solve", {}).get("p99Ms", 0.0),
+                "write_back_p99_ms": seg.get("write-back", {}).get("p99Ms", 0.0),
+                "other_p99_ms": seg.get("other", {}).get("p99Ms", 0.0),
+            }
+            locks = {l["name"]: l for l in con.get("locks", [])}
+            plock = locks.get("extender.predicate")
+            if plock is not None:
+                lane["lock_acquisitions"] = plock["acquisitions"]
+                lane["lock_contended"] = plock["contended"]
+                lane["lock_wait_ms_p95"] = plock["waitMs"]["p95"]
+                lane["lock_hold_ms_p95"] = plock["holdMs"]["p95"]
+                lane["lock_hold_ms_p99"] = plock["holdMs"]["p99"]
+            LANES["contention http"] = lane
+            stats["criticalpath_coverage_p50"] = lane["coverage_p50"]
+            stats["criticalpath_dominant"] = max(
+                cp.get("dominant", {}) or {"": 0},
+                key=lambda k: cp["dominant"].get(k, 0),
+            )
+            print(
+                f"# contention: coverage p50={lane['coverage_p50']} "
+                f"solve p99={lane['solve_p99_ms']:.1f}ms "
+                f"serde p99={lane['serde_p99_ms']:.1f}ms "
+                f"write-back p99={lane['write_back_p99_ms']:.1f}ms "
+                f"lock hold p95={lane.get('lock_hold_ms_p95', 0.0)}ms",
+                file=sys.stderr,
+            )
+        except Exception as err:
+            print(f"# contention scrape failed: {err}", file=sys.stderr)
         LANES["config5-e2e http"] = stats
         SECONDARY["config5_e2e_p99_ms"] = round(p99, 1)
         SECONDARY["config5_e2e_p50_ms"] = round(float(np.percentile(lat, 50)), 1)

@@ -16,11 +16,15 @@ The declaration is consumed twice:
   (:mod:`.racecheck`): when the detector is active, instances created
   by a decorated class get their lock attribute wrapped in a tracked
   proxy so the detector knows exactly which locks each thread holds at
-  every instrumented mutation.
+  every instrumented mutation;
+- **at runtime** by the contention observatory
+  (:mod:`..contention.locktime`): every instance's lock is wrapped in
+  a ``TimedLock`` at construction (the always-on timing layer; it
+  records wait/hold stats only while the process-wide timekeeper is
+  enabled, and its disabled path costs one module-attribute read).
 
-The decorator is a no-op in production: with the detector inactive it
-only registers metadata and returns the class unchanged apart from a
-thin ``__init__`` wrapper (one attribute check per construction).
+In production the decorator therefore adds only the ``TimedLock``
+shim: metadata registration plus a thin ``__init__`` wrapper.
 """
 
 from __future__ import annotations
@@ -45,6 +49,13 @@ def guarded_by(lock_attr: str, *fields: str):
         @functools.wraps(original_init)
         def init(self, *args, **kwargs):
             original_init(self, *args, **kwargs)
+            # contention timing wraps the raw lock FIRST (always-on;
+            # recording gates on the locktime switchboard), so when the
+            # race detector is also active its TrackedLock proxy ends
+            # up outermost and the timing layer measures the real lock
+            from ..contention import locktime
+
+            locktime.wrap_instance(self, cls, lock_attr)
             # late import: racecheck imports nothing heavy, but keeping
             # the hot (disabled) path to one module-attribute read
             from . import racecheck

@@ -147,6 +147,31 @@ class CapacityConfig:
 
 
 @dataclass
+class ContentionConfig:
+    """Contention observatory (contention/): lock wait/hold telemetry
+    and per-request critical-path decomposition behind
+    ``/debug/contention`` + ``/debug/criticalpath``.  Diagnostic only.
+
+    ``enabled`` turns the process-wide timekeeper on (TimedLock
+    wrappers exist regardless; disabled they cost one attribute read
+    per acquire).  ``ring_size`` bounds the per-request decomposition
+    ring; ``sample_every`` is the uncontended-acquire sampling stride
+    for ``@guarded_by`` locks (contended acquires always record)."""
+
+    enabled: bool = True
+    ring_size: int = 256
+    sample_every: int = 64
+
+    @staticmethod
+    def from_dict(d: dict) -> "ContentionConfig":
+        return ContentionConfig(
+            enabled=d.get("enabled", True),
+            ring_size=d.get("ring-size", 256),
+            sample_every=d.get("sample-every", 64),
+        )
+
+
+@dataclass
 class ConversionWebhookConfig:
     """Where the apiserver reaches the CRD conversion webhook (the
     reference wires this from the witchcraft server's service identity,
@@ -193,6 +218,9 @@ class Install:
     # capacity observatory: fragmentation/headroom analytics and the
     # /state/capacity timeline (capacity/) — diagnostic only
     capacity: CapacityConfig = field(default_factory=CapacityConfig)
+    # contention observatory: lock wait/hold telemetry + critical-path
+    # decomposition (contention/) — diagnostic only
+    contention: ContentionConfig = field(default_factory=ContentionConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -266,4 +294,5 @@ class Install:
             resilience=ResilienceConfig.from_dict(d.get("resilience", {})),
             provenance=ProvenanceConfig.from_dict(d.get("provenance", {})),
             capacity=CapacityConfig.from_dict(d.get("capacity", {})),
+            contention=ContentionConfig.from_dict(d.get("contention", {})),
         )

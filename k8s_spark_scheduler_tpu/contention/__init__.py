@@ -1,0 +1,14 @@
+"""Contention observatory: lock wait/hold telemetry, critical-path
+latency decomposition, and the data behind ``GET /debug/contention`` /
+``GET /debug/criticalpath`` (ISSUE 11; the before/after yardstick for
+breaking the single extender lock, ROADMAP item 1).
+
+- :mod:`.locktime` — ``TimedLock`` + the process-wide
+  :class:`~.locktime.LockTimekeeper` switchboard: per-lock wait/hold
+  reservoirs, span-phase holder attribution, top-blocker tables.
+- :mod:`.criticalpath` — span-tree walker that decomposes each request
+  into gate-queue / lock-wait / serde / solve / write-back / other.
+"""
+
+from .criticalpath import CriticalPathAnalyzer, decompose  # noqa: F401
+from .locktime import LockTimekeeper, TimedLock  # noqa: F401

@@ -92,6 +92,7 @@ class ReporterSet:
             self.report_informer_delay,
             self.report_jit_cache_sizes,
             self.report_resilience,
+            self.report_contention,
             self.report_registry_series,
         ):
             try:
@@ -265,6 +266,18 @@ class ReporterSet:
         # capacity gauges) must not keep exporting its last, too-high
         # series count — the canary tracks the registry, not history
         self.metrics.prune_gauges(names.METRICS_REGISTRY_SERIES, published)
+
+    # -- contention -----------------------------------------------------------
+
+    def report_contention(self) -> None:
+        """Drain the lock-telemetry pending buffers into wait/hold
+        histograms.  TimedLock never publishes from the lock path (the
+        registry's own lock is a TimedLock — publishing there would
+        recurse), so the reporter tick is the drain point."""
+        from ..contention import locktime
+
+        if locktime.active():
+            locktime.publish(self.metrics)
 
     # -- resilience ----------------------------------------------------------
 

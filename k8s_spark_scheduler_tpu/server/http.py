@@ -17,6 +17,11 @@
 - ``GET /explain/<pod>`` — the decision-provenance record as JSON:
   snapshot keys, queue slice, verdicts, and for refusals the
   tightest-dimension shortfall + blocker set (provenance/)
+- ``GET /debug/contention`` — per-lock wait/hold percentiles, holder
+  attribution, and top blockers (contention/locktime.py)
+- ``GET /debug/criticalpath`` — per-request latency decomposition:
+  gate-queue / lock-wait / serde / solve / write-back / other
+  (contention/criticalpath.py)
 """
 
 from __future__ import annotations
@@ -206,6 +211,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_explain(unquote(path[len("/explain/"):]))
         elif path.startswith("/state/capacity") and self.scheduler is not None:
             self._handle_capacity(path, query)
+        elif path == "/debug/contention" and self.scheduler is not None:
+            self._handle_debug_contention(query)
+        elif path == "/debug/criticalpath" and self.scheduler is not None:
+            self._handle_debug_criticalpath(query)
         else:
             self._send_json(404, {"error": "not found"})
 
@@ -347,6 +356,46 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": "not found"})
 
+    def _handle_debug_contention(self, query) -> None:
+        """Lock wait/hold telemetry (contention/locktime.py): per-lock
+        reservoir percentiles, holder-phase attribution, and the
+        top-blocker table.  ``?lock=<name>`` filters to one lock site.
+        Reading also drains pending samples into the metrics registry
+        so a scrape right after stays fresh."""
+        keeper = getattr(self.scheduler, "contention", None)
+        if keeper is None:
+            self._send_json(200, {"enabled": False, "locks": []})
+            return
+        name = query.get("lock", [None])[0] if query.get("lock") else None
+        keeper.publish(self.scheduler.metrics)
+        self._send_json(
+            200,
+            {
+                "enabled": True,
+                "locks": keeper.snapshot(name_filter=name),
+            },
+        )
+
+    def _handle_debug_criticalpath(self, query) -> None:
+        """Per-request latency decomposition (contention/
+        criticalpath.py): which segment — gate-queue, lock-wait, serde,
+        solve, write-back — the milliseconds went to, summarized over
+        the recent-request ring.  ``?limit=N`` appends the N newest
+        per-request records."""
+        analyzer = getattr(self.scheduler, "criticalpath", None)
+        if analyzer is None:
+            self._send_json(200, {"enabled": False, "requests": 0})
+            return
+        out = {"enabled": True}
+        out.update(analyzer.summary())
+        try:
+            limit = int(query.get("limit", [""])[0])
+        except (ValueError, IndexError):
+            limit = 0
+        if limit:
+            out["recent"] = analyzer.recent(limit=limit)
+        self._send_json(200, out)
+
     def _handle_debug_schedule(self, pod_name: str) -> None:
         """Explain the last scheduling decision for a pod: the newest
         trace tagged pod=<name> rendered as a text span tree, with the
@@ -416,7 +465,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_post(self):
         try:
-            body = self._read_json()
+            # body read + JSON parse under its own span: it is part of
+            # the serde segment in the critical-path decomposition
+            with tracing.child_span("http.read"):
+                body = self._read_json()
         except (ValueError, json.JSONDecodeError) as err:
             self._send_json(400, {"error": f"bad json: {err}"})
             return
@@ -429,7 +481,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(503, {"error": "scheduler not ready"})
                 return
             try:
-                args = serde.extender_args_from_dict(body)
+                # serde is a first-class segment of the request critical
+                # path (contention/criticalpath.py): at the 10k-node
+                # shape the ExtenderArgs parse and the FailedNodes
+                # encode, not the solver, dominate the handler
+                with tracing.child_span("serde.decode"):
+                    args = serde.extender_args_from_dict(body)
             except Exception as err:
                 self._send_json(400, {"error": f"bad ExtenderArgs: {err}"})
                 return
@@ -437,11 +494,9 @@ class _Handler(BaseHTTPRequestHandler):
             # encoded uniform failures come from a reusable buffer pool
             # (serde.encode_extender_filter_result) — the 10k-entry
             # FailedNodes map serializes once per (candidates, message)
-            self._send_bytes(
-                200,
-                serde.encode_extender_filter_result(result),
-                "application/json",
-            )
+            with tracing.child_span("serde.encode"):
+                encoded = serde.encode_extender_filter_result(result)
+            self._send_bytes(200, encoded, "application/json")
         elif self.path == "/convert":
             self._send_json(200, convert_review(body))
         else:
@@ -460,7 +515,17 @@ class _Handler(BaseHTTPRequestHandler):
         if kit is None:
             return self.scheduler.extender.predicate(args)
         try:
+            # admission-gate queueing is a named critical-path segment;
+            # today's gate is non-blocking (admit-or-shed) so this is
+            # ~0, but the tag keeps the decomposition honest if the
+            # gate ever grows a wait queue
+            t_gate = time.perf_counter()
             with kit.gate.admit():
+                span = tracing.current_span()
+                if span is not None:
+                    span.tags["gateWaitMs"] = round(
+                        (time.perf_counter() - t_gate) * 1000.0, 4
+                    )
                 with req_deadline.bind(kit.request_timeout):
                     return self.scheduler.extender.predicate(args)
         except AdmissionShed:

@@ -68,6 +68,8 @@ class Server:
     resilience: ResilienceKit = None
     provenance: object = None  # ProvenanceTracker (provenance/tracker.py)
     capacity: object = None  # CapacitySampler (capacity/observatory.py)
+    contention: object = None  # LockTimekeeper (contention/locktime.py)
+    criticalpath: object = None  # CriticalPathAnalyzer (contention/criticalpath.py)
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
@@ -328,6 +330,15 @@ def init_server_with_clients(
     unschedulable_polling_interval: float = 60.0,
 ) -> Server:
     """cmd/server.go:65-237, bottom-up."""
+    # contention observatory switchboard FIRST: the guarded singletons
+    # constructed below get their sampling stride from it, and enabling
+    # before construction means their very first acquires record
+    contention_keeper = None
+    if install.contention.enabled:
+        from ..contention import locktime
+
+        locktime.set_default_sample_every(install.contention.sample_every)
+        contention_keeper = locktime.enable()
     metrics = MetricsRegistry()
     event_log = EventLog()
     # request tracing + kernel profiling sinks.  The profiler is a
@@ -336,6 +347,17 @@ def init_server_with_clients(
     # correct for the one-server-per-process production shape.
     tracer = Tracer(capacity=256, metrics=metrics)
     kernel_profiling.default_profiler.configure(metrics=metrics, tracer=tracer)
+    # critical-path extraction rides trace completion: every finished
+    # request tree decomposes into gate-queue / lock-wait / serde /
+    # solve / write-back segments (contention/criticalpath.py)
+    criticalpath_analyzer = None
+    if install.contention.enabled:
+        from ..contention import CriticalPathAnalyzer
+
+        criticalpath_analyzer = CriticalPathAnalyzer(
+            metrics=metrics, capacity=install.contention.ring_size
+        )
+        tracer.add_observer(criticalpath_analyzer.on_trace)
     # node-name interning counters land in THIS server's registry (the
     # interner is module-level for the same reason the profiler is)
     from ..types import serde as _serde
@@ -529,6 +551,8 @@ def init_server_with_clients(
         resilience=resilience_kit,
         provenance=provenance_tracker,
         capacity=capacity_sampler,
+        contention=contention_keeper,
+        criticalpath=criticalpath_analyzer,
     )
     server.reporters = ReporterSet(server)
 

@@ -105,6 +105,13 @@ def main(argv=None) -> int:
                     json.dumps(sample, sort_keys=True, separators=(",", ":"))
                     + "\n"
                 )
+        # contention report (predicate-lock wait/hold + critical-path
+        # decomposition): the "is the lock or the solver the bottleneck"
+        # artifact for the chaos-CI job
+        with open(os.path.join(args.out, "contention.json"), "w") as f:
+            json.dump(
+                result.summary.get("contention"), f, indent=2, sort_keys=True
+            )
 
     if not args.quiet:
         json.dump(result.summary, sys.stdout, indent=2, sort_keys=True)

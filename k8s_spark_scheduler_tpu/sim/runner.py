@@ -101,6 +101,10 @@ class Simulation:
         sc = self.scenario
         t_wall0 = time.perf_counter()
         timesource.set_source(self.clock.now)
+        # span durations too: a sim trace is virtual end to end (the
+        # clock never advances inside a handler, so sim span durations
+        # are exactly 0 unless an event fires mid-span)
+        timesource.set_perf_source(self.clock.now)
         try:
             self._build()
             self._seed_events()
@@ -864,6 +868,7 @@ class Simulation:
         }
         summary["capacity"] = self._capacity_summary()
         summary["waste_phases"] = self._waste_summary()
+        summary["contention"] = self._contention_summary()
         sampler = getattr(self.harness.server, "capacity", None) if self.harness else None
         timeline = (
             [s.to_dict() for s in sampler.timeline()] if sampler is not None else []
@@ -875,6 +880,34 @@ class Simulation:
             violations=list(self.auditor.violations) if self.auditor else [],
             capacity_timeline=timeline,
         )
+
+    def _contention_summary(self) -> Optional[Dict]:
+        """Contention scorecard columns: the extender predicate lock's
+        wait/hold distributions plus the per-request critical-path ring.
+        Read straight off the harness server's own instances (never the
+        process-global lock registry — parallel tests would cross-bleed).
+        Wait/hold numbers are real wall-clock, so they live in the
+        summary only — the digest never sees them."""
+        if self.harness is None:
+            return None
+        lock = getattr(self.harness.server.extender, "_predicate_lock", None)
+        analyzer = getattr(self.harness.server, "criticalpath", None)
+        if lock is None and analyzer is None:
+            return None
+        out: Dict = {}
+        if lock is not None and hasattr(lock, "snapshot"):
+            snap = lock.snapshot()
+            out["predicate_lock"] = {
+                "acquisitions": snap["acquisitions"],
+                "contended": snap["contended"],
+                "wait_ms_p95": snap["waitMs"]["p95"],
+                "wait_ms_max": snap["waitMs"]["max"],
+                "hold_ms_p95": snap["holdMs"]["p95"],
+                "top_blockers": snap["topBlockers"][:3],
+            }
+        if analyzer is not None:
+            out["criticalpath"] = analyzer.summary()
+        return out or None
 
     def _capacity_summary(self) -> Optional[Dict]:
         """Fragmentation / headroom / queue-pressure percentiles over the

@@ -9,11 +9,15 @@ production it IS ``time.time``; the discrete-event simulator
 of cluster life replay in milliseconds and timers fire at simulated
 instants, deterministically.
 
-Latency *measurement* (``perf_counter`` spans, histograms) and
-harness/infrastructure deadlines (``time.monotonic`` waits) are
-intentionally NOT routed through here: a sim run still wants real
-decision latencies, and a frozen virtual clock must never turn a
-bounded wait into an infinite one.
+Span *durations* go through the separate :func:`perf` hook (default
+``time.perf_counter``): a trace must not mix virtual start instants
+with wall-clock durations, so the simulator installs its clock for
+both and a completed sim trace is virtual end to end.  Everything
+else that measures latency (lock wait/hold telemetry, bench loops)
+and harness/infrastructure deadlines (``time.monotonic`` waits) stays
+on the real clock on purpose: a sim run still wants real decision
+latencies, and a frozen virtual clock must never turn a bounded wait
+into an infinite one.
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ import time
 from typing import Callable
 
 _source: Callable[[], float] = time.time
+_perf: Callable[[], float] = time.perf_counter
 
 
 def now() -> float:
@@ -39,10 +44,26 @@ def set_source(fn: Callable[[], float]) -> None:
     _source = fn
 
 
+def perf() -> float:
+    """Monotonic instant for span durations (seconds; no defined
+    epoch).  Real ``perf_counter`` in production, the virtual clock in
+    a sim run — keeping every number inside one trace on one
+    timeline."""
+    return _perf()
+
+
+def set_perf_source(fn: Callable[[], float]) -> None:
+    """Install a replacement duration source for spans.  Same process-
+    wide scope and reset obligation as :func:`set_source`."""
+    global _perf
+    _perf = fn
+
+
 def reset() -> None:
-    """Restore the real wall clock."""
-    global _source
+    """Restore the real wall clock (both sources)."""
+    global _source, _perf
     _source = time.time
+    _perf = time.perf_counter
 
 
 def is_virtual() -> bool:

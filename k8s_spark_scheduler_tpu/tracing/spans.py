@@ -121,11 +121,13 @@ class Span:
         # semantic instant, not latency: sim traces carry virtual time
         self.start_time = timesource.now()
         self._token = _CURRENT.set(self)
-        self._t0 = time.perf_counter()
+        # duration through the same pluggable source family: a sim
+        # trace must not mix virtual timestamps with wall durations
+        self._t0 = timesource.perf()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
-        self.duration = time.perf_counter() - self._t0
+        self.duration = timesource.perf() - self._t0
         if exc is not None and "error" not in self.tags:
             self.tags["error"] = f"{type(exc).__name__}: {exc}"
         if self._token is not None:
@@ -180,6 +182,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._metrics = metrics
         self._record_span_metrics = record_span_metrics
+        # trace-completion observers (e.g. the critical-path analyzer):
+        # called with the live root Span after the tree lands in the
+        # ring, outside the ring lock.  Wiring-time append only.
+        self._observers: list = []
 
     # -- span creation --------------------------------------------------------
 
@@ -227,6 +233,16 @@ class Tracer:
                     {mnames.TAG_SPAN: span.name},
                 )
                 stack.extend(span.children)
+        for observer in self._observers:
+            try:
+                observer(root)
+            except Exception:  # an observer must never break a request
+                pass
+
+    def add_observer(self, fn) -> None:
+        """Register a trace-completion callback ``fn(root_span)``.
+        Call at wiring time only — the list is read unlocked."""
+        self._observers.append(fn)
 
     def traces(self, limit: Optional[int] = None) -> List[dict]:
         """Completed traces, newest first."""

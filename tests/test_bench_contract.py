@@ -119,6 +119,31 @@ def test_bench_final_line_is_the_headline(tmp_path):
             # ≤ 2 + ceil(log2(k_max)) + 1 evaluations per shape
             assert 0 < capl["solves_per_probe"] <= 16 * 23
             assert capl["solves_per_shape_p50"] <= 23
+
+        # contention-lane contract (PR 11): the e2e phase scrapes the
+        # live server's /debug/criticalpath + /debug/contention and pins
+        # the latency decomposition and predicate-lock stats as their
+        # own lane; the headline carries the coverage + dominant-segment
+        # annotations.  tools/perf_regression.py gates on these exact
+        # key names, so they are part of the durable artifact contract.
+        con = artifact["lanes"].get("contention http")
+        assert con is not None, "e2e phase ran but no contention lane"
+        for key in (
+            "total_p99_ms", "solve_p99_ms", "serde_p99_ms",
+            "write_back_p99_ms", "gate_queue_p99_ms", "lock_wait_p99_ms",
+            "other_p99_ms", "lock_hold_ms_p99",
+        ):
+            assert isinstance(con[key], (int, float)), key
+        assert con["window"] >= headline["samples"]
+        assert 0.0 < con["coverage_p50"] <= 1.0
+        assert con["lock_acquisitions"] > 0
+        # the named segments reconstruct the end-to-end p99 within the
+        # acceptance bound (sum of per-segment p99s upper-bounds the
+        # total p99, and coverage keeps "other" small)
+        assert headline["criticalpath_coverage_p50"] == con["coverage_p50"]
+        assert headline["criticalpath_dominant"] in (
+            "solve", "serde", "write-back", "gate-queue", "lock-wait", "other",
+        )
     else:
         assert headline["metric"].startswith("p99_queue_solve")
         assert lane is None

@@ -1,0 +1,368 @@
+"""Contention observatory (contention/): TimedLock wait/hold telemetry
+with holder/blocker attribution, the critical-path decomposition, and
+the layering with PR 9's race detector (timing innermost, detector
+outermost)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from k8s_spark_scheduler_tpu.analysis import racecheck
+from k8s_spark_scheduler_tpu.analysis.guarded import guarded_by
+from k8s_spark_scheduler_tpu.contention import locktime
+from k8s_spark_scheduler_tpu.contention.criticalpath import (
+    SEGMENT_NAMES,
+    CriticalPathAnalyzer,
+    decompose,
+)
+from k8s_spark_scheduler_tpu.contention.locktime import LockTimekeeper, TimedLock
+from k8s_spark_scheduler_tpu.metrics import names as M
+from k8s_spark_scheduler_tpu.metrics.registry import MetricsRegistry
+
+
+@pytest.fixture
+def keeper():
+    """A fresh keeper for the duration of the test, restoring whatever
+    switchboard state the process had before (server fixtures in the
+    same process enable one globally)."""
+    prev = locktime.get()
+    kp = LockTimekeeper()
+    locktime.enable(kp)
+    try:
+        yield kp
+    finally:
+        if prev is not None:
+            locktime.enable(prev)
+        else:
+            locktime.disable()
+
+
+@pytest.fixture
+def fixed_phase():
+    """Pin the phase attribution to a deterministic fake span."""
+    span = SimpleNamespace(name="test-phase", tags={})
+
+    prev = locktime._current_span
+    locktime._current_span = lambda: span
+    try:
+        yield span
+    finally:
+        locktime._current_span = prev
+
+
+# -- TimedLock ----------------------------------------------------------------
+
+
+def test_wait_hold_and_blocker_attribution(keeper, fixed_phase):
+    lock = TimedLock(threading.Lock(), "t.contended", sample_every=1)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder, name="holder-thread")
+    t.start()
+    entered.wait(5.0)
+    time.sleep(0.01)
+    releaser = threading.Timer(0.05, release.set)
+    releaser.start()
+    t0 = time.perf_counter()
+    with lock:
+        waited_s = time.perf_counter() - t0
+    t.join(5.0)
+    releaser.join(5.0)
+
+    snap = lock.snapshot()
+    assert snap["acquisitions"] == 2
+    assert snap["contended"] == 1
+    # the contended wait is recorded and is of the right magnitude
+    assert snap["waitMs"]["count"] >= 1
+    assert snap["waitMs"]["max"] >= 30.0
+    assert snap["waitMs"]["max"] <= waited_s * 1000.0 + 1.0
+    # both holds recorded (sample_every=1), attributed to the phase
+    assert snap["holdMs"]["count"] == 2
+    assert snap["holdMs"]["max"] >= 40.0
+    assert "test-phase" in snap["byPhase"]
+    assert snap["byPhase"]["test-phase"]["holds"] == 2
+    # blame: the wait is charged to the holder's phase
+    assert snap["topBlockers"]
+    assert snap["topBlockers"][0]["holderPhase"] == "test-phase"
+    assert snap["topBlockers"][0]["totalWaitMs"] >= 30.0
+
+
+def test_uncontended_sampling_stride(keeper):
+    lock = TimedLock(threading.Lock(), "t.sampled", sample_every=4)
+    for _ in range(100):
+        with lock:
+            pass
+    snap = lock.snapshot()
+    assert snap["acquisitions"] == 100
+    assert snap["contended"] == 0
+    # 1-in-4 uncontended acquires record (wait=0 point + a hold)
+    assert snap["waitMs"]["count"] == 25
+    assert snap["holdMs"]["count"] == 25
+    assert snap["waitMs"]["max"] == 0.0
+
+
+def test_reentrant_only_outermost_timed(keeper):
+    lock = TimedLock(threading.RLock(), "t.reentrant", sample_every=1)
+    assert lock.locked() is False
+    with lock:
+        assert lock.locked() is True
+        with lock:
+            assert lock.locked() is True
+        assert lock.locked() is True  # inner release keeps the hold
+    assert lock.locked() is False
+    snap = lock.snapshot()
+    assert snap["acquisitions"] == 1  # only the outermost acquire counts
+    assert snap["holdMs"]["count"] == 1
+
+
+def test_failed_probe_records_nothing(keeper):
+    lock = TimedLock(threading.Lock(), "t.probe", sample_every=1)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    entered.wait(5.0)
+    try:
+        assert lock.acquire(blocking=False) is False
+        assert lock.locked() is True
+    finally:
+        release.set()
+        t.join(5.0)
+    snap = lock.snapshot()
+    assert snap["acquisitions"] == 1  # the holder's, not the probe's
+    assert snap["contended"] == 0
+
+
+def test_disabled_lock_still_works_and_records_nothing():
+    prev = locktime.get()
+    locktime.disable()
+    try:
+        lock = TimedLock(threading.Lock(), "t.disabled", sample_every=1)
+        for _ in range(10):
+            with lock:
+                pass
+        assert lock.acquire(blocking=False) is True
+        lock.release()
+        rlock = TimedLock(threading.RLock(), "t.disabled.r", sample_every=1)
+        with rlock:
+            with rlock:
+                assert rlock.locked() is True
+        assert rlock.locked() is False
+        assert lock.snapshot()["acquisitions"] == 0
+    finally:
+        if prev is not None:
+            locktime.enable(prev)
+
+
+def test_tag_waits_stamps_active_span(keeper, fixed_phase):
+    lock = TimedLock(threading.Lock(), "t.tagged", sample_every=1, tag_waits=True)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    entered.wait(5.0)
+    releaser = threading.Timer(0.03, release.set)
+    releaser.start()
+    with lock:
+        pass
+    t.join(5.0)
+    releaser.join(5.0)
+    assert fixed_phase.tags.get("lockWaitMs", 0.0) >= 15.0
+    # accumulates across acquires rather than overwriting
+    first = fixed_phase.tags["lockWaitMs"]
+    with lock:
+        pass
+    assert fixed_phase.tags["lockWaitMs"] >= first
+
+
+def test_keeper_snapshot_merges_instances_and_publishes(keeper, fixed_phase):
+    a = TimedLock(threading.Lock(), "t.shared", sample_every=1)
+    b = TimedLock(threading.Lock(), "t.shared", sample_every=1)
+    for lk in (a, b):
+        for _ in range(3):
+            with lk:
+                pass
+    merged = {s["name"]: s for s in keeper.snapshot(name_filter="t.shared")}
+    assert merged["t.shared"]["instances"] == 2
+    assert merged["t.shared"]["acquisitions"] == 6
+
+    registry = MetricsRegistry()
+    keeper.publish(registry)
+    snap = registry.snapshot()
+    gauges = snap["gauges"]
+    acquire_keys = [k for k in gauges if M.LOCK_ACQUIRE_COUNT in k and "t.shared" in k]
+    assert acquire_keys, sorted(gauges)
+    hold = registry.get_histogram(
+        M.LOCK_HOLD_TIME, {M.TAG_LOCK: "t.shared", M.TAG_PHASE: "test-phase"}
+    )
+    assert hold["count"] == 6
+    # pending buffers drained: publishing twice adds nothing
+    keeper.publish(registry)
+    hold = registry.get_histogram(
+        M.LOCK_HOLD_TIME, {M.TAG_LOCK: "t.shared", M.TAG_PHASE: "test-phase"}
+    )
+    assert hold["count"] == 6
+
+
+# -- layering with racecheck ---------------------------------------------------
+
+
+def test_guarded_by_wraps_timed_then_tracked():
+    @guarded_by("_lock", "value")
+    class Guarded:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+
+    plain = Guarded()
+    assert isinstance(plain._lock, TimedLock)
+    assert isinstance(plain._lock._inner, type(threading.Lock()))
+
+    det = racecheck.enable(racecheck.RaceDetector())
+    try:
+        layered = Guarded()
+        # detector outermost, timing innermost, raw lock at the core
+        assert isinstance(layered._lock, racecheck.TrackedLock)
+        assert isinstance(layered._lock._inner, TimedLock)
+        with layered._lock:
+            racecheck.note_access(layered, "value")
+            layered.value += 1
+        assert det.races == []
+    finally:
+        racecheck.disable()
+
+
+# -- critical-path decomposition ----------------------------------------------
+
+
+def _span(name, duration_s, tags=None, children=()):
+    return SimpleNamespace(
+        name=name,
+        duration=duration_s,
+        tags=tags or {},
+        children=list(children),
+        trace_id="trace-1",
+        start_time=123.0,
+    )
+
+
+def _request_trace(total_s=0.100):
+    return _span(
+        "http.request",
+        total_s,
+        tags={"path": "/predicates", "gateWaitMs": 5.0, "lockWaitMs": 10.0},
+        children=[
+            _span("http.read", 0.004),
+            _span("serde.decode", 0.006),
+            _span(
+                "predicate",
+                0.060,
+                children=[
+                    _span("binpack", 0.030, children=[_span("kernel:solve", 0.020)]),
+                    _span(
+                        "reservation.writeback",
+                        0.010,
+                        children=[_span("state.writeback.enqueue", 0.002)],
+                    ),
+                ],
+            ),
+            _span("serde.encode", 0.005),
+        ],
+    )
+
+
+def test_decompose_exclusive_attribution():
+    record = decompose(_request_trace())
+    assert record is not None
+    seg = record["segments"]
+    assert record["totalMs"] == pytest.approx(100.0)
+    # serde: read 4 + decode 6 + encode 5
+    assert seg["serde"] == pytest.approx(15.0)
+    # solve: predicate self 20 + binpack self 10 + kernel 20 = 50
+    assert seg["solve"] == pytest.approx(50.0)
+    # write-back: writeback self 8 + enqueue 2
+    assert seg["write-back"] == pytest.approx(10.0)
+    assert seg["gate-queue"] == pytest.approx(5.0)
+    assert seg["lock-wait"] == pytest.approx(10.0)
+    # root self-time 25 minus the two synthetic gaps
+    assert seg["other"] == pytest.approx(10.0)
+    # exclusive attribution reconstructs the root exactly
+    assert sum(seg.values()) == pytest.approx(record["totalMs"])
+    assert record["coverage"] == pytest.approx(0.9)
+    assert record["dominant"] == "solve"
+
+
+def test_decompose_skips_non_request_and_virtual_traces():
+    assert decompose(_span("reconcile", 0.05)) is None
+    other_path = _span("http.request", 0.05, tags={"path": "/metrics"})
+    assert decompose(other_path) is None
+    # virtual-time sim traces: no measurable duration
+    assert decompose(_request_trace(total_s=0.0)) is None
+    # a bare predicate trace (no HTTP wrapper) still decomposes
+    bare = _span("predicate", 0.05, children=[_span("binpack", 0.03)])
+    assert decompose(bare) is not None
+
+
+def test_analyzer_ring_summary_and_metrics():
+    registry = MetricsRegistry()
+    analyzer = CriticalPathAnalyzer(metrics=registry, capacity=4)
+    for _ in range(10):
+        analyzer.on_trace(_request_trace())
+    analyzer.on_trace(_span("reconcile", 0.05))  # ignored
+
+    assert len(analyzer.recent()) == 4  # ring bound
+    assert analyzer.recent(limit=2) == analyzer.recent()[:2]
+    summary = analyzer.summary()
+    assert summary["requests"] == 10 and summary["window"] == 4
+    assert set(summary["segments"]) == set(SEGMENT_NAMES)
+    assert summary["segments"]["solve"]["p50Ms"] == pytest.approx(50.0)
+    assert summary["totalMs"]["p99"] == pytest.approx(100.0)
+    assert summary["dominant"] == {"solve": 10}
+
+    hist = registry.get_histogram(
+        M.CRITICALPATH_SEGMENT_TIME, {M.TAG_SEGMENT: "solve"}
+    )
+    assert hist["count"] == 10
+    cov = registry.get_histogram(M.CRITICALPATH_COVERAGE)
+    assert cov["count"] == 10
+
+
+def test_analyzer_observer_never_breaks_requests():
+    """A tracer observer raising must not propagate into the request
+    path (spans.py swallows observer exceptions)."""
+    from k8s_spark_scheduler_tpu.tracing.spans import Tracer
+
+    tracer = Tracer(metrics=None)
+    seen = []
+
+    def bad_observer(root):
+        seen.append(root.name)
+        raise RuntimeError("observer bug")
+
+    tracer.add_observer(bad_observer)
+    # a span with no active parent opens a new root trace; closing it
+    # fires the observers
+    with tracer.span("http.request", {"path": "/predicates"}):
+        pass
+    assert seen == ["http.request"]
+    assert len(tracer.traces()) == 1  # trace still landed in the ring

@@ -813,3 +813,144 @@ def test_traces_limit_param(served_fifo):
         _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
     status, body = _get(http.port, "/traces?limit=2")
     assert status == 200 and len(body["traces"]) == 2
+
+
+def test_debug_criticalpath_endpoint(served_fifo):
+    """ISSUE 11 satellite: /debug/criticalpath decomposes served
+    requests into the named gating segments, and per-request records
+    reconstruct the request total."""
+    api, scheduler, http = served_fifo
+    _create_nodes(api)
+    for i in range(3):
+        driver_json, _ = _driver_pod_json(f"app-cp-{i}", executors=1)
+        api.create(serde.pod_from_dict(driver_json))
+        _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+
+    status, body = _get(http.port, "/debug/criticalpath")
+    assert status == 200 and body["enabled"] is True
+    assert body["requests"] >= 3 and body["window"] >= 3
+    segs = body["segments"]
+    for name in ("gate-queue", "lock-wait", "serde", "solve", "write-back", "other"):
+        assert name in segs, segs.keys()
+        assert segs[name]["p99Ms"] >= 0.0
+    # the solver does the work in this configuration
+    assert segs["solve"]["p50Ms"] > 0.0
+    assert body["totalMs"]["p99"] > 0.0
+    assert 0.0 <= body["coverage"]["p50"] <= 1.0
+    assert body["dominant"], "dominant-segment counter empty"
+
+    # per-request records: named segments reconstruct the request
+    status, body = _get(http.port, "/debug/criticalpath?limit=2")
+    assert status == 200 and len(body["recent"]) == 2
+    for record in body["recent"]:
+        total = record["totalMs"]
+        assert total > 0.0
+        reconstructed = sum(record["segments"].values())
+        assert abs(reconstructed - total) / total < 0.10, record
+        assert record["traceId"]
+
+
+def test_debug_contention_endpoint(served_fifo):
+    """ISSUE 11 satellite: /debug/contention serves per-lock wait/hold
+    distributions with holder-phase attribution; ?lock= filters."""
+    api, scheduler, http = served_fifo
+    _create_nodes(api)
+    driver_json, _ = _driver_pod_json("app-lock", executors=1)
+    api.create(serde.pod_from_dict(driver_json))
+    _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+
+    status, body = _get(http.port, "/debug/contention")
+    assert status == 200 and body["enabled"] is True
+    locks = {entry["name"]: entry for entry in body["locks"]}
+    assert "extender.predicate" in locks, sorted(locks)
+    plock = locks["extender.predicate"]
+    assert plock["acquisitions"] >= 1
+    assert plock["sampleEvery"] == 1  # the predicate lock records every acquire
+    assert plock["holdMs"]["count"] >= 1 and plock["holdMs"]["max"] > 0.0
+    assert "http.request" in plock["byPhase"], plock["byPhase"]
+    # the @guarded_by singletons are wrapped too (names = declaration site)
+    assert any(name.endswith("._lock") for name in locks), sorted(locks)
+
+    status, body = _get(http.port, "/debug/contention?lock=extender.predicate")
+    assert status == 200
+    assert [entry["name"] for entry in body["locks"]] == ["extender.predicate"]
+    status, body = _get(http.port, "/debug/contention?lock=no-such-lock")
+    assert status == 200 and body["locks"] == []
+
+
+def test_contention_endpoints_empty_and_disabled(served):
+    """Empty cluster: both endpoints answer 200 with empty-but-well-
+    formed payloads.  A server wired with contention.enabled=false
+    reports disabled instead of erroring."""
+    _, _, http = served
+    status, body = _get(http.port, "/debug/criticalpath")
+    assert status == 200 and body["enabled"] is True
+    assert body["requests"] == 0 and body["window"] == 0
+    assert body["totalMs"]["p99"] == 0.0
+    status, body = _get(http.port, "/debug/contention")
+    assert status == 200 and body["enabled"] is True  # locks exist, idle
+
+    from k8s_spark_scheduler_tpu.config import ContentionConfig
+
+    api = APIServer()
+    api.create_crd(DEMAND_CRD_NAME, demand_crd_spec())
+    scheduler = init_server_with_clients(
+        api,
+        Install(
+            binpack_algo="tightly-pack",
+            contention=ContentionConfig(enabled=False),
+        ),
+        demand_poll_interval=0.02,
+    )
+    http2 = ExtenderHTTPServer(scheduler, port=0)
+    http2.start()
+    try:
+        status, body = _get(http2.port, "/debug/contention")
+        assert status == 200 and body["enabled"] is False
+        status, body = _get(http2.port, "/debug/criticalpath")
+        assert status == 200 and body["enabled"] is False
+    finally:
+        http2.stop()
+        scheduler.stop()
+
+
+def test_contention_gauges_render_in_plain_and_openmetrics(served_fifo):
+    """ISSUE 11 satellite: the new lock/criticalpath metrics follow the
+    exposition rules — plain 0.0.4 text under every Accept header, and
+    the opt-in OpenMetrics flavour stays well-formed."""
+    api, scheduler, http = served_fifo
+    _create_nodes(api)
+    driver_json, _ = _driver_pod_json("app-lockmet", executors=1)
+    api.create(serde.pod_from_dict(driver_json))
+    _post(http.port, "/predicates", {"Pod": driver_json, "NodeNames": ["n0", "n1"]})
+    # reading /debug/contention drains pending lock samples into the registry
+    assert _get(http.port, "/debug/contention")[0] == 200
+
+    status, headers, raw = _get_raw(
+        http.port, "/metrics", {"Accept": "text/plain;version=0.0.4"}
+    )
+    assert status == 200
+    plain = raw.decode()
+    assert "foundry_spark_scheduler_tpu_lock_acquire_count" in plain
+    assert "foundry_spark_scheduler_tpu_lock_hold_time" in plain
+    assert 'lock="extender.predicate"' in plain
+    assert "foundry_spark_scheduler_tpu_criticalpath_segment_time" in plain
+    assert 'segment="solve"' in plain
+    assert "# EOF" not in plain and "trace_id" not in plain
+
+    status, headers, raw = _get_raw(http.port, "/metrics?format=openmetrics")
+    assert status == 200
+    assert headers.get("Content-Type").startswith("application/openmetrics-text")
+    om = raw.decode()
+    assert "foundry_spark_scheduler_tpu_lock_acquire_count" in om
+    assert "foundry_spark_scheduler_tpu_criticalpath_segment_time" in om
+    assert om.rstrip().endswith("# EOF")
+
+    # strict OpenMetrics Accept still gets plain text (PR 6 rule)
+    status, headers, raw = _get_raw(
+        http.port, "/metrics",
+        {"Accept": "application/openmetrics-text;version=1.0.0"},
+    )
+    assert status == 200
+    assert headers.get("Content-Type").startswith("text/plain")
+    assert b"foundry_spark_scheduler_tpu_lock_acquire_count" in raw

@@ -436,6 +436,98 @@ def test_racecheck_disabled_overhead_within_budget():
     assert per_call_us < 5.0, f"disabled note_access {per_call_us:.3f}µs/call"
 
 
+def test_locktime_disabled_overhead_within_budget():
+    """ISSUE 11 acceptance (disabled half): with no timekeeper enabled
+    a TimedLock acquire/release is one module-attribute read + a None
+    check on top of the raw lock — same contract (and same budget
+    shape) as the disabled racecheck checkpoint above."""
+    import threading
+
+    from k8s_spark_scheduler_tpu.contention import locktime
+
+    prev = locktime.get()
+    locktime.disable()
+    try:
+        raw = threading.Lock()
+        timed = locktime.TimedLock(threading.Lock(), "perf.guard")
+        n = 200_000
+
+        def run_raw():
+            for _ in range(n):
+                with raw:
+                    pass
+
+        def run_timed():
+            for _ in range(n):
+                with timed:
+                    pass
+
+        run_raw(); run_timed()  # warm
+        base_s = _best_of(run_raw)
+        timed_s = _best_of(run_timed)
+        per_call_us = timed_s / n * 1e6
+        budget_s = base_s * 4.0 + n * 1.5e-6  # 4x the raw lock + 1.5µs/call
+        assert timed_s <= budget_s, (
+            f"disabled TimedLock {per_call_us:.3f}µs/acquire exceeds budget "
+            f"(raw lock baseline {base_s / n * 1e6:.3f}µs/acquire)"
+        )
+        # hard ceiling independent of the baseline: the disabled path
+        # must never grow real work (no clock reads, no reservoirs)
+        assert per_call_us < 5.0, f"disabled TimedLock {per_call_us:.3f}µs/acquire"
+    finally:
+        if prev is not None:
+            locktime.enable(prev)
+
+
+def test_locktime_enabled_overhead_within_budget():
+    """ISSUE 11 acceptance (enabled half): timing mode on the Filter
+    path stays within disabled × 1.05 plus absolute CI-noise slack.
+    The sampled reservoir (stride 64) + pending-buffer append is the
+    entire enabled cost — no publishing happens on the lock path."""
+    from k8s_spark_scheduler_tpu.contention import locktime
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h = Harness()
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        driver = h.static_allocation_spark_pods("app-lock-perf", 1)[0]
+        h.assert_success(h.schedule(driver, ["n1", "n2"]))  # creates the RR
+
+        extender = h.server.extender
+        args = ExtenderArgs(pod=driver, node_names=["n1", "n2"])
+        n = 50
+        prev = locktime.get()
+        assert prev is not None, "harness wiring must enable the timekeeper"
+
+        def batch():
+            for _ in range(n):
+                extender.predicate(args)
+
+        batch()  # warm caches/jit
+        locktime.disable()
+        try:
+            disabled_s = _best_of(batch)
+        finally:
+            locktime.enable(prev)
+        batch()  # warm the timed path
+        enabled_s = _best_of(batch)
+
+        budget = disabled_s * 1.05 + n * 0.5e-3  # 5% relative + 0.5ms/request
+        assert enabled_s <= budget, (
+            f"lock-timing overhead: {enabled_s * 1e3:.2f}ms per {n}-request "
+            f"batch enabled vs {disabled_s * 1e3:.2f}ms disabled "
+            f"(budget {budget * 1e3:.2f}ms)"
+        )
+        # enabled requests actually recorded stats (the guard must not
+        # pass because timing silently stopped running)
+        snap = extender._predicate_lock.snapshot()
+        assert snap["acquisitions"] > 0
+    finally:
+        h.close()
+
+
 def test_predicate_latency_with_tracing_within_budget():
     from k8s_spark_scheduler_tpu.testing.harness import Harness
 

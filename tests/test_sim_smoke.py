@@ -71,3 +71,37 @@ def test_chaos_example_scenario_parses():
     kinds = {f.kind for f in sc.faults}
     assert {"node_kill", "failover", "executor_storm", "node_cordon"} <= kinds
     assert sc.autoscaler.enabled and sc.autoscaler.delay > 0
+
+
+def test_sim_traces_are_virtual_end_to_end_with_contention_summary():
+    """Sim-time skew regression (ISSUE 11): span durations go through
+    ``timesource.perf``, which the sim points at the virtual clock — a
+    request runs while virtual time is frozen, so every span in every
+    sim trace must report exactly 0.0ms.  A non-zero duration means a
+    wall-clock read snuck back into the span path and sim traces would
+    again mix virtual timestamps with wall durations.  The contention
+    scorecard, by contrast, is real wall telemetry by design."""
+    sc = Scenario.from_file(os.path.join(_EXAMPLES, "smoke.json"))
+    sim = Simulation(sc)
+    result = sim.run()
+    assert result.violations == []
+
+    traces = sim.harness.server.tracer.traces()
+    assert traces, "sim requests must produce traces"
+
+    def walk(span):
+        yield span
+        for child in span.get("children", ()):
+            yield from walk(child)
+
+    for trace in traces:
+        assert trace["durationMs"] == 0.0, trace["traceId"]
+        for span in walk(trace["root"]):
+            assert span["durationMs"] == 0.0, (trace["traceId"], span["name"])
+
+    # the contention scorecard rides along in the summary (real wall
+    # numbers, deliberately outside the deterministic digest)
+    con = result.summary["contention"]
+    assert con is not None
+    assert con["predicate_lock"]["acquisitions"] > 0
+    assert con["criticalpath"]["requests"] >= 0

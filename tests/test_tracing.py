@@ -229,3 +229,55 @@ def test_events_stamp_trace_id():
     assert log.all()[-1].trace_id == ""
     # empty trace_id never matches
     assert log.by_trace_id("") == []
+
+
+# -- sim-time skew -----------------------------------------------------------
+
+
+def test_span_duration_follows_perf_source():
+    """Sim-time skew regression: span durations go through
+    ``timesource.perf()``, not ``time.perf_counter`` directly — with a
+    virtual source installed a span's duration is the *virtual* delta,
+    and wall time spent inside the span never leaks in."""
+    import time
+
+    from k8s_spark_scheduler_tpu import timesource
+
+    t = [100.0]
+    timesource.set_source(lambda: t[0])
+    timesource.set_perf_source(lambda: t[0])
+    try:
+        tracer = Tracer()
+        with tracer.span("root"):
+            with tracer.span("child"):
+                t[0] += 2.5  # virtual advance inside the child
+            time.sleep(0.005)  # wall time must NOT appear in durations
+        (trace,) = tracer.traces()
+        assert trace["root"]["startTime"] == 100.0
+        assert trace["durationMs"] == pytest.approx(2500.0)
+        child = trace["root"]["children"][0]
+        assert child["durationMs"] == pytest.approx(2500.0)
+    finally:
+        timesource.reset()
+
+
+def test_span_duration_zero_on_frozen_virtual_clock():
+    """A sim request runs while the virtual clock is static, so every
+    span in the trace must report 0.0ms — a non-zero duration means a
+    wall-clock read snuck back into the span path."""
+    import time
+
+    from k8s_spark_scheduler_tpu import timesource
+
+    timesource.set_source(lambda: 42.0)
+    timesource.set_perf_source(lambda: 42.0)
+    try:
+        tracer = Tracer()
+        with tracer.span("http.request"):
+            with tracer.span("predicate"):
+                time.sleep(0.002)
+        (trace,) = tracer.traces()
+        assert trace["durationMs"] == 0.0
+        assert trace["root"]["children"][0]["durationMs"] == 0.0
+    finally:
+        timesource.reset()

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Continuous perf-regression baseline check over the committed bench
+trajectory (BENCH_r*.json) — the contention observatory's third leg.
+
+Every bench round appends an artifact (``BENCH_r06.json`` onward
+carries full per-lane stats; earlier rounds only the headline, some
+with ``parsed: null``).  This tool fits a tolerance band per metric
+from the recent comparable history and fails when the current artifact
+(``BENCH_RESULT.json`` by default, or ``--current`` for a fresh run)
+regresses past the band:
+
+- **headline**: the north-star p99 (only rounds reporting the same
+  ``metric`` name are comparable — early rounds measured the solver
+  lane, not the HTTP boundary)
+- **lanes**: per-lane ``p99_ms`` for every lane present both in the
+  current artifact and in lane-carrying history rounds
+- **contention lane**: the critical-path/lock keys of the
+  ``contention http`` lane (solve / serde / write-back p99s, predicate
+  lock hold p99) so a lock- or serde-side regression fails even when
+  the headline still squeaks under its band
+
+Band fit: baseline = median of the last ``--window`` comparable
+values; tolerance = max(``--tolerance-floor``, half the window's
+relative spread).  Bench numbers on shared CI hosts are noisy — the
+floor (default 0.35) is deliberately generous; the band catches the
+2x-style regressions that matter, not 10% jitter.
+
+    python tools/perf_regression.py --json perf-regression.json
+
+Exit 0 = every check inside its band (or not enough history — a new
+metric needs one committed round before it can regress); exit 1 = at
+least one regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+DEFAULT_TOLERANCE_FLOOR = 0.35
+DEFAULT_WINDOW = 4
+
+# the contention-lane keys worth gating on (all "lower is better" ms)
+CONTENTION_KEYS = (
+    "total_p99_ms",
+    "solve_p99_ms",
+    "serde_p99_ms",
+    "write_back_p99_ms",
+    "lock_hold_ms_p99",
+)
+
+
+def load_history(repo: str) -> List[Dict[str, Any]]:
+    """The committed trajectory, oldest first, tolerating sparse early
+    rounds: ``parsed`` may be null (crashed tail parse) and lanes only
+    exist from round 6 on."""
+    entries: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = raw.get("parsed") if isinstance(raw, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        headline = parsed.get("headline") if isinstance(parsed.get("headline"), dict) else parsed
+        entries.append(
+            {
+                "round": int(m.group(1)),
+                "path": os.path.basename(path),
+                "metric": headline.get("metric"),
+                "value": headline.get("value"),
+                "lanes": parsed.get("lanes") if isinstance(parsed.get("lanes"), dict) else None,
+            }
+        )
+    entries.sort(key=lambda e: e["round"])
+    return entries
+
+
+def load_current(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        artifact = json.load(f)
+    headline = artifact.get("headline") or {}
+    return {
+        "path": os.path.basename(path),
+        "metric": headline.get("metric"),
+        "value": headline.get("value"),
+        "lanes": artifact.get("lanes") or {},
+    }
+
+
+def fit_band(history_values: List[float], floor: float, window: int) -> Optional[Dict[str, float]]:
+    """Baseline + threshold from the last ``window`` comparable values.
+    None when there is no history to regress against."""
+    values = [float(v) for v in history_values if isinstance(v, (int, float)) and v > 0]
+    if not values:
+        return None
+    recent = values[-window:]
+    ordered = sorted(recent)
+    baseline = ordered[len(ordered) // 2]
+    spread = (ordered[-1] - ordered[0]) / baseline if baseline > 0 else 0.0
+    tolerance = max(floor, 0.5 * spread)
+    return {
+        "baseline": round(baseline, 4),
+        "tolerance": round(tolerance, 4),
+        "threshold": round(baseline * (1.0 + tolerance), 4),
+        "points": len(recent),
+    }
+
+
+def _lane_metric_values(history, lane_name, key):
+    out = []
+    for entry in history:
+        lanes = entry.get("lanes")
+        if not lanes:
+            continue
+        lane = lanes.get(lane_name)
+        if isinstance(lane, dict) and isinstance(lane.get(key), (int, float)):
+            out.append(float(lane[key]))
+    return out
+
+
+def run_checks(
+    history: List[Dict[str, Any]],
+    current: Dict[str, Any],
+    floor: float = DEFAULT_TOLERANCE_FLOOR,
+    window: int = DEFAULT_WINDOW,
+) -> Dict[str, Any]:
+    checks: List[Dict[str, Any]] = []
+
+    def add(name: str, current_value, band) -> None:
+        if band is None or not isinstance(current_value, (int, float)):
+            checks.append(
+                {"check": name, "status": "skipped", "reason": "insufficient history"}
+            )
+            return
+        status = "pass" if float(current_value) <= band["threshold"] else "fail"
+        checks.append({"check": name, "status": status, "current": current_value, **band})
+
+    # headline: only same-metric rounds are comparable
+    headline_history = [
+        e["value"] for e in history if e["metric"] and e["metric"] == current["metric"]
+    ]
+    add(
+        f"headline:{current['metric']}",
+        current["value"],
+        fit_band(headline_history, floor, window),
+    )
+
+    # per-lane p99 + the contention lane's named keys
+    for lane_name, lane in sorted((current.get("lanes") or {}).items()):
+        if not isinstance(lane, dict):
+            continue
+        keys = CONTENTION_KEYS if lane_name == "contention http" else ("p99_ms",)
+        for key in keys:
+            if not isinstance(lane.get(key), (int, float)):
+                continue
+            values = _lane_metric_values(history, lane_name, key)
+            add(f"lane:{lane_name}:{key}", lane[key], fit_band(values, floor, window))
+
+    failed = [c for c in checks if c["status"] == "fail"]
+    return {
+        "current": current["path"],
+        "history_rounds": [e["path"] for e in history],
+        "tolerance_floor": floor,
+        "window": window,
+        "checks": checks,
+        "failures": len(failed),
+        "pass": not failed,
+    }
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parser = argparse.ArgumentParser(
+        description="bench-trajectory perf-regression gate"
+    )
+    parser.add_argument("--repo", default=repo, help="repo root holding BENCH_r*.json")
+    parser.add_argument(
+        "--current",
+        default=None,
+        help="artifact to check (default: <repo>/BENCH_RESULT.json)",
+    )
+    parser.add_argument(
+        "--tolerance-floor", type=float, default=DEFAULT_TOLERANCE_FLOOR
+    )
+    parser.add_argument("--window", type=int, default=DEFAULT_WINDOW)
+    parser.add_argument("--json", default=None, help="write the report here too")
+    args = parser.parse_args(argv)
+
+    current_path = args.current or os.path.join(args.repo, "BENCH_RESULT.json")
+    if not os.path.exists(current_path):
+        print(f"no current artifact at {current_path}", file=sys.stderr)
+        return 2
+    history = load_history(args.repo)
+    report = run_checks(
+        history,
+        load_current(current_path),
+        floor=args.tolerance_floor,
+        window=args.window,
+    )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    for check in report["checks"]:
+        if check["status"] == "skipped":
+            line = f"SKIP {check['check']} ({check['reason']})"
+        else:
+            line = (
+                f"{check['status'].upper():4s} {check['check']}: "
+                f"{check['current']} vs baseline {check['baseline']} "
+                f"(threshold {check['threshold']}, n={check['points']})"
+            )
+        print(line)
+    print(
+        f"perf-regression: {len(report['checks'])} checks, "
+        f"{report['failures']} failures"
+    )
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
