@@ -711,6 +711,7 @@ def cpu_fallback() -> dict:
     _deltasolve_measure(problem)
     _provenance_measure(problem)
     _capacity_probe_measure(problem)
+    _preemption_whatif_measure(problem)
 
     args = _device_args(problem)
 
@@ -1064,6 +1065,65 @@ def _capacity_probe_measure(problem) -> None:
         )
     except Exception as err:
         print(f"# capacity-probe lane unavailable: {err}", file=sys.stderr)
+
+
+def _preemption_whatif_measure(problem) -> None:
+    """Policy-engine contract (ISSUE 14): the preemption what-if solve
+    at the bench node shape × 16 preemptor gangs, as its own lane.  A
+    what-if validates one candidate victim set — ``gang_feasible`` on
+    ``avail + freed`` — and the selector runs up to ``max_victims`` of
+    them per refused driver, so its per-call latency bounds the cost a
+    preemption attempt adds to a Filter round.  Pure numpy (the
+    fallback when no warm delta-solve session exists), so the lane is
+    unconditional."""
+    try:
+        from k8s_spark_scheduler_tpu.policy.victims import whatif_fits
+
+        n_nodes = problem.avail.shape[0]
+        n_gangs = 16
+        take = max(min(n_gangs, problem.driver.shape[0]), 1)
+        gangs = [
+            (
+                problem.driver[i % take],
+                problem.executor[i % take],
+                int(problem.count[i % take]),
+            )
+            for i in range(n_gangs)
+        ]
+        # a victim set's freed capacity: a few whole applications'
+        # worth of executors returned across a handful of nodes
+        # (deterministic; the verdict itself is irrelevant to latency)
+        rng = np.random.default_rng(7)
+        freed = np.zeros((n_nodes, 3), dtype=problem.avail.dtype)
+        victim_nodes = rng.choice(n_nodes, size=min(8, n_nodes), replace=False)
+        for i, node in enumerate(victim_nodes):
+            freed[node] = problem.executor[i % take] * 3
+        reps = max(ROUNDS, 10)
+        whatif_ms = []
+        fits = 0
+        for _ in range(reps):
+            fits = 0
+            for gang in gangs:
+                t0 = time.perf_counter()
+                ok = whatif_fits(
+                    problem.avail, problem.exec_ok, problem.driver_rank,
+                    freed, gang,
+                )
+                whatif_ms.append((time.perf_counter() - t0) * 1000.0)
+                fits += int(ok)
+        lat = np.array(whatif_ms)
+        stats = _lane_stats(lat, fits)
+        stats["whatif_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+        stats["gangs"] = n_gangs
+        LANES["preemption-whatif cpu"] = stats
+        SECONDARY["preemption_whatif_p50_ms"] = stats["whatif_p50_ms"]
+        print(
+            f"# [preemption-whatif cpu] whatif_p50={stats['whatif_p50_ms']}ms "
+            f"p99={stats['p99_ms']}ms ({n_gangs} gangs, {n_nodes} nodes)",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        print(f"# preemption-whatif lane unavailable: {err}", file=sys.stderr)
 
 
 def _check_load() -> bool:

@@ -501,6 +501,149 @@ def _sampler_scenario() -> Scenario:
     )
 
 
+# ---------------------------------------------------------------------------
+# 7. PreemptionCoordinator: admission vs. commit vs. failover replay
+# ---------------------------------------------------------------------------
+
+
+def _preemption_scenario() -> Scenario:
+    """Concurrent admission, a preemption commit, and a failover
+    recover() replaying a predecessor's pending evict intent.  The
+    exactly-once contract under every interleaving: no lost eviction
+    (every intent executed and acked — journal drains), no double-evict
+    (no pod is ever successfully deleted twice), and admission of an
+    uninvolved app is never disturbed."""
+    from ..kube.errors import NotFoundError
+    from ..policy.preempt import EVICT_KIND, PreemptionCoordinator
+    from ..policy.victims import VictimCandidate, VictimPlan
+
+    @guarded_by("_lock", "pods", "rrs", "pod_deletes")
+    class Cluster:
+        """Pod + RR state shared by the fake api and rr_cache views;
+        counts SUCCESSFUL deletes per pod — the double-evict witness."""
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pods = {"app-a-driver", "app-a-exec-1", "app-b-driver", "app-b-exec-1"}
+            self.rrs = {"app-a", "app-b"}
+            self.pod_deletes: dict = {}
+
+        def delete_pod(self, name: str) -> None:
+            with self._lock:
+                racecheck.note_access(self, "pods")
+                racecheck.note_access(self, "pod_deletes")
+                if name not in self.pods:
+                    raise NotFoundError(f"pod {name}")
+                self.pods.remove(name)
+                self.pod_deletes[name] = self.pod_deletes.get(name, 0) + 1
+
+        def delete_rr(self, name: str) -> None:
+            with self._lock:
+                racecheck.note_access(self, "rrs")
+                if name not in self.rrs:
+                    raise NotFoundError(f"rr {name}")
+                self.rrs.remove(name)
+
+        def add_rr(self, name: str) -> None:
+            with self._lock:
+                racecheck.note_access(self, "rrs")
+                self.rrs.add(name)
+
+    class FakeAPI:
+        def __init__(self, cluster):
+            self._cluster = cluster
+
+        def delete(self, kind, ns, name):
+            self._cluster.delete_pod(name)
+
+    class FakeRRCache:
+        def __init__(self, cluster):
+            self._cluster = cluster
+
+        def delete(self, ns, name):
+            self._cluster.delete_rr(name)
+
+    def _plan(app: str) -> VictimPlan:
+        return VictimPlan(
+            preemptor_app="storm-001",
+            preemptor_band="high",
+            victims=[
+                VictimCandidate(
+                    namespace="ns", app_id=app, band="low", band_rank=0,
+                    tenant="t", created=1.0,
+                    freed=np.zeros((1, 3), dtype=np.int64),
+                    pods=[f"{app}-driver", f"{app}-exec-1"],
+                )
+            ],
+            whatif_ms=0.0,
+            lane="numpy",
+        )
+
+    class State:
+        def __init__(self):
+            self.cluster = Cluster()
+            self.coordinator = PreemptionCoordinator(
+                api=FakeAPI(self.cluster), rr_cache=FakeRRCache(self.cluster)
+            )
+            # the predecessor instance journaled app-a's eviction and
+            # crashed before executing it: a pending intent recover()
+            # must replay exactly once
+            self.coordinator._journal.record(
+                "delete", EVICT_KIND, "ns", "app-a",
+                {"pods": ["app-a-driver", "app-a-exec-1"], "reason": "crashed",
+                 "preemptor": "storm-000", "band": "low", "tenant": "t"},
+            )
+
+    def setup():
+        return State()
+
+    def threads(st: State):
+        def active_commit():
+            st.coordinator.commit(_plan("app-b"))
+
+        def standby_recover():
+            st.coordinator.recover()
+
+        def admitter():
+            st.cluster.add_rr("app-c")
+            checkpoint("post-admission")
+            snap = st.coordinator.state()
+            assert snap["evictionsTotal"] >= 0
+
+        return [
+            ("commit", active_commit),
+            ("recover", standby_recover),
+            ("admitter", admitter),
+        ]
+
+    def invariant(st: State):
+        with st.cluster._lock:
+            deletes = dict(st.cluster.pod_deletes)
+        for pod, n in deletes.items():
+            assert n <= 1, f"double-evict: pod {pod} successfully deleted {n}x"
+
+    def final(st: State):
+        # no lost eviction: every intent executed and acked
+        assert st.coordinator.journal_depth() == 0, "evict intent left pending"
+        with st.cluster._lock:
+            pods, rrs = set(st.cluster.pods), set(st.cluster.rrs)
+        assert not pods, f"victim pods survived eviction: {sorted(pods)}"
+        assert rrs == {"app-c"}, f"expected only the admitted app's RR, got {sorted(rrs)}"
+        evicted = {e["app"] for e in st.coordinator.state()["recent"]}
+        assert evicted == {"app-a", "app-b"}, f"evicted set wrong: {sorted(evicted)}"
+
+    return Scenario(
+        name="preemption-commit-vs-recover",
+        setup=setup,
+        threads=threads,
+        invariant=invariant,
+        final=final,
+        description="concurrent admission, preemption commit and failover "
+        "replay: no lost eviction, no double-evict, journal drains on "
+        "every interleaving",
+    )
+
+
 def corpus() -> List[Scenario]:
     return [
         _changefeed_scenario(),
@@ -509,4 +652,5 @@ def corpus() -> List[Scenario]:
         _gate_scenario(),
         _engine_scenario(),
         _sampler_scenario(),
+        _preemption_scenario(),
     ]

@@ -172,6 +172,57 @@ class ContentionConfig:
 
 
 @dataclass
+class PolicyConfig:
+    """Scheduling-policy engine (policy/): priority classes, pluggable
+    queue ordering, conservative backfill, gang-aware preemption, and
+    DRF fair share.
+
+    ``enabled=False`` (the default) constructs no engine at all —
+    extender decisions are byte-identical to pre-policy behavior
+    (pinned by tests/test_policy.py).  ``ordering`` is one of ``fifo``,
+    ``priority-then-fifo``, ``drf``; ``bands`` maps band name → rank
+    (higher = more important) read from the driver pod's ``band_label``
+    label.  Preemption evicts WHOLE applications only, each victim set
+    validated by a what-if solve and journaled before any delete."""
+
+    enabled: bool = False
+    ordering: str = "fifo"
+    band_label: str = "spark-priority-band"
+    bands: Dict[str, int] = field(
+        default_factory=lambda: {"low": 0, "normal": 1, "high": 2}
+    )
+    default_band: str = "normal"
+    tenant_label: str = "spark-tenant"
+    preemption_enabled: bool = False
+    # a preemptor must outrank a victim by at least this many bands
+    preemption_min_band_gap: int = 1
+    max_victims: int = 4
+    backfill: bool = False
+    # backfill may never skip a queue head older than this (I-P3)
+    starvation_age_seconds: float = 600.0
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
+    recent_evictions: int = 64
+
+    @staticmethod
+    def from_dict(d: dict) -> "PolicyConfig":
+        return PolicyConfig(
+            enabled=d.get("enabled", False),
+            ordering=d.get("ordering", "fifo"),
+            band_label=d.get("band-label", "spark-priority-band"),
+            bands=dict(d.get("bands", {"low": 0, "normal": 1, "high": 2})),
+            default_band=d.get("default-band", "normal"),
+            tenant_label=d.get("tenant-label", "spark-tenant"),
+            preemption_enabled=d.get("preemption-enabled", False),
+            preemption_min_band_gap=d.get("preemption-min-band-gap", 1),
+            max_victims=d.get("max-victims", 4),
+            backfill=d.get("backfill", False),
+            starvation_age_seconds=d.get("starvation-age-seconds", 600.0),
+            tenant_weights=dict(d.get("tenant-weights", {})),
+            recent_evictions=d.get("recent-evictions", 64),
+        )
+
+
+@dataclass
 class ConversionWebhookConfig:
     """Where the apiserver reaches the CRD conversion webhook (the
     reference wires this from the witchcraft server's service identity,
@@ -221,6 +272,9 @@ class Install:
     # contention observatory: lock wait/hold telemetry + critical-path
     # decomposition (contention/) — diagnostic only
     contention: ContentionConfig = field(default_factory=ContentionConfig)
+    # scheduling policy: priority bands, ordering, backfill, preemption,
+    # DRF (policy/) — disabled = byte-identical FIFO decisions
+    policy: PolicyConfig = field(default_factory=PolicyConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -295,4 +349,5 @@ class Install:
             provenance=ProvenanceConfig.from_dict(d.get("provenance", {})),
             capacity=CapacityConfig.from_dict(d.get("capacity", {})),
             contention=ContentionConfig.from_dict(d.get("contention", {})),
+            policy=PolicyConfig.from_dict(d.get("policy", {})),
         )

@@ -194,6 +194,21 @@ METRICS_REGISTRY_SERIES = (
     "foundry.spark.scheduler.tpu.metrics.registry.series"
 )
 
+# policy engine (policy/): priority ordering, backfill, gang-aware
+# preemption, DRF fair share
+# committed preemptions (one per validated victim plan)
+POLICY_PREEMPTION_COUNT = "foundry.spark.scheduler.tpu.policy.preemption.count"
+# whole applications evicted across all preemptions
+POLICY_PREEMPTION_VICTIMS = (
+    "foundry.spark.scheduler.tpu.policy.preemption.victims"
+)
+# victim-set what-if validation latency (milliseconds; histogram)
+POLICY_WHATIF_MS = "foundry.spark.scheduler.tpu.policy.preemption.whatif.ms"
+# per-tenant weighted dominant share (gauge, tagged tenant=)
+POLICY_DRF_SHARE = "foundry.spark.scheduler.tpu.policy.drf.share"
+# blocked queue heads safely skipped by the conservative backfill probe
+POLICY_BACKFILL_SKIPS = "foundry.spark.scheduler.tpu.policy.backfill.skips"
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"

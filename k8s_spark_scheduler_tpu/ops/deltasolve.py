@@ -223,6 +223,26 @@ class DeltaSolveEngine:
             "session_bytes": session_bytes,
         }
 
+    def latest_basis(self):
+        """(node_names, avail64 [N,3] int64, exec_ok [N] bool,
+        driver_rank [N] int64) of the most recently used session's
+        cluster view, or None when no session is resident.  The policy
+        engine's what-if victim validation rides this warm basis — the
+        post-build availability the last solve actually ran against —
+        instead of re-deriving one from the raw snapshot."""
+        with self._lock:
+            racecheck.note_access(self, "_sessions")
+            if not self._sessions:
+                return None
+            sess = next(reversed(self._sessions.values()))
+        c = sess.cluster
+        return (
+            list(c.node_names),
+            np.asarray(c.avail, dtype=np.int64),
+            np.asarray(c.exec_ok, dtype=bool),
+            np.asarray(c.driver_rank, dtype=np.int64),
+        )
+
     def invalidate(self) -> None:
         """Drop every session (tests / explicit failover hooks; organic
         invalidation flows through the content rules in the docstring).

@@ -1,0 +1,135 @@
+"""Pluggable queue orders + the conservative backfill probe.
+
+Orders map each pending driver to a sort key; the queue solve itself is
+untouched — the policy only changes *which* drivers count as "earlier"
+and in what sequence they are proved, so the gang-atomicity guarantee
+(every queue-ahead app fits before this one admits) is preserved under
+every ordering.
+
+Backfill (EASY-style, conservative): a lower-band app may admit into
+current holes past a blocked queue head only when a what-if placement
+probe proves it cannot delay the head's earliest start — the candidate
+consumes only capacity the head could not have used anyway.  The probe
+reuses the solver's own admission rule (``step_app_plain`` semantics
+via :mod:`..capacity.probe`), so a "safe" verdict is a statement about
+the real solver, not a heuristic twin.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..capacity.probe import INT32_SAFE, _feasible, caps_unclamped
+
+ORDER_FIFO = "fifo"
+ORDER_PRIORITY = "priority-then-fifo"
+ORDER_DRF = "drf"
+ORDERINGS = (ORDER_FIFO, ORDER_PRIORITY, ORDER_DRF)
+
+# (driver_row[3], executor_row[3], count) in base units
+Gang = Tuple[np.ndarray, np.ndarray, int]
+
+
+def queue_sort_key(ordering: str, band_rank: int, dominant_share: float, pod):
+    """Sort key for one pending driver.  Ties always break
+    (creation_timestamp, name) so every ordering is a total order and
+    the fifo ordering is EXACTLY the pre-policy comparator."""
+    if ordering == ORDER_PRIORITY:
+        return (-band_rank, pod.creation_timestamp, pod.name)
+    if ordering == ORDER_DRF:
+        # DRF deficit order: the tenant furthest BELOW its dominant
+        # share goes first (Ghodsi et al. NSDI'11, progressive filling)
+        return (dominant_share, pod.creation_timestamp, pod.name)
+    return (pod.creation_timestamp, pod.name)
+
+
+def gang_feasible(
+    avail: np.ndarray, exec_ok: np.ndarray, driver_rank: np.ndarray, gang: Gang
+) -> bool:
+    """The solver's admission rule for one gang at queue position 0."""
+    driver, executor, count = gang
+    cand_mask = np.asarray(driver_rank, dtype=np.int64) < INT32_SAFE
+    caps = caps_unclamped(avail, exec_ok, executor)
+    return _feasible(avail, exec_ok, cand_mask, caps, driver, executor, int(count))
+
+
+def place_gang(
+    avail: np.ndarray, exec_ok: np.ndarray, driver_rank: np.ndarray, gang: Gang
+) -> Optional[np.ndarray]:
+    """Greedy deterministic placement: driver on the best-ranked fitting
+    candidate, executors packed onto highest-capacity nodes.  Returns
+    the availability AFTER placement, or None when the gang does not
+    fit.  The placement is a lower bound on how much capacity any real
+    placement would consume — sufficient for the conservative backfill
+    verdict, which only compares before/after headroom."""
+    driver, executor, count = gang
+    if not gang_feasible(avail, exec_ok, driver_rank, gang):
+        return None
+    rank = np.asarray(driver_rank, dtype=np.int64)
+    fits = (rank < INT32_SAFE) & (avail >= driver).all(axis=1)
+    idx = np.flatnonzero(fits)
+    if not len(idx):
+        return None
+    after = avail.copy()
+    dnode = idx[np.argmin(rank[idx])]
+    after[dnode] -= driver
+    remaining = int(count)
+    if remaining > 0:
+        caps = np.clip(caps_unclamped(after, exec_ok, executor), 0, remaining)
+        order = np.argsort(-caps, kind="stable")
+        for i in order:
+            if remaining <= 0:
+                break
+            k = int(min(caps[i], remaining))
+            if k <= 0:
+                break
+            after[i] -= executor * k
+            remaining -= k
+        if remaining > 0:
+            # greedy packing failed even though the admission rule
+            # passed (cannot happen for step_app_plain semantics, but
+            # fail closed rather than report a bogus placement)
+            return None
+    return after
+
+
+def backfill_cannot_delay(
+    avail: np.ndarray,
+    exec_ok: np.ndarray,
+    driver_rank: np.ndarray,
+    head: Gang,
+    candidate: Gang,
+) -> bool:
+    """True iff admitting ``candidate`` now provably cannot delay the
+    blocked queue head's earliest start.
+
+    Conservative rule: after the candidate's greedy placement, the
+    head's feasibility verdict AND its clamped capacity total AND its
+    driver-fitting candidate count must be unchanged — the candidate
+    consumed only capacity the head could not have used.  Any probe
+    failure (candidate infeasible, head capacity moved) refuses the
+    backfill; refusing is always safe (the queue just stays FIFO).
+    """
+    after = place_gang(avail, exec_ok, driver_rank, candidate)
+    if after is None:
+        return False
+    h_driver, h_executor, h_count = head
+    rank = np.asarray(driver_rank, dtype=np.int64)
+    cand_mask = rank < INT32_SAFE
+
+    def head_view(basis: np.ndarray):
+        caps = np.clip(
+            caps_unclamped(basis, exec_ok, h_executor), 0, max(int(h_count), 1)
+        )
+        feasible = _feasible(
+            basis, exec_ok, cand_mask, caps_unclamped(basis, exec_ok, h_executor),
+            h_driver, h_executor, int(h_count),
+        )
+        driver_fit = int((cand_mask & (basis >= h_driver).all(axis=1)).sum())
+        return feasible, int(caps.sum()), driver_fit
+
+    before_view = head_view(avail)
+    after_view = head_view(after)
+    return before_view == after_view

@@ -203,6 +203,15 @@ class ProvenanceTracker:
             if feed_seq is not None:
                 p["feed_seq"] = feed_seq
 
+    def pending_shortfall(self):
+        """The memoized ShortfallInfo of the decision in flight (None
+        until a refusal has been explained) — the policy engine reads
+        its ``blockers`` list as the victim-candidate seed."""
+        with self._pending_lock:
+            racecheck.note_access(self, "_pending")
+            p = self._pending
+            return p.get("shortfall") if p else None
+
     def capture(self, artifacts: SolveArtifacts) -> None:
         """The solver lanes' capture sink (engine + solve_tensor)."""
         with self._pending_lock:

@@ -114,6 +114,7 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         resilience=None,
         delta_solve: bool = True,
         provenance=None,
+        policy=None,
     ):
         self._node_informer = node_informer
         self._pod_lister = pod_lister
@@ -166,6 +167,11 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         # keeps every capture sink None — the solver lanes then run with
         # zero provenance work (the perf guard pins this)
         self._provenance = provenance
+        # scheduling-policy engine (policy/engine.py): None (the
+        # default) keeps every hook a single attribute check — the
+        # Filter path is then byte-identical to pre-policy behavior
+        # (the perf guard + 5-seed identity test pin this)
+        self._policy = policy
         if provenance is not None and provenance.enabled:
             solver = getattr(binpacker, "queue_solver", None)
             if solver is not None and hasattr(solver, "capture_sink"):
@@ -388,6 +394,40 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         detail = prov.refusal_detail(kind)
         return f"{base}: {detail}" if detail else base
 
+    # -- policy hooks (no-ops when no engine is configured) ------------------
+
+    def _earlier_drivers(self, driver: Pod) -> List[Pod]:
+        """The queue-ahead set for the FIFO gate; the policy engine may
+        re-order it (priority-then-fifo, DRF) without touching the
+        queue solve itself."""
+        if self._policy is not None:
+            return self._policy.earlier_queue(driver)
+        return self._pod_lister.list_earlier_drivers(driver)
+
+    def _skip_verdict(self, queued: Pod, driver: Pod, skip_cutoff: float) -> bool:
+        """enforce-after-age skip verdict for one queued driver,
+        optionally widened by the policy engine's conservative backfill
+        probe (which can only ADD skips, never remove one)."""
+        base = queued.creation_timestamp > skip_cutoff
+        if self._policy is not None:
+            return self._policy.skip_allowed(queued, driver, base)
+        return base
+
+    def _raise_driver_refusal(
+        self, driver: Pod, app_resources, outcome: str, base_message: str, kind: str
+    ):
+        """Shared refusal tail for the driver path: enrich the message
+        with the shortfall explain, give the policy engine its
+        preemption shot (the explain memoized the blocker set it
+        seeds from), and stamp any committed victim set into the
+        FailedNodes message."""
+        message = self._refusal_message(base_message, kind)
+        if self._policy is not None:
+            note = self._policy.on_driver_refusal(driver, app_resources, outcome)
+            if note:
+                message = f"{message}; {note}"
+        raise SchedulingFailure(outcome, message)
+
     def _fail_with_message(self, outcome: str, args: ExtenderArgs, message: str) -> ExtenderFilterResult:
         if self._waste_reporter is not None:
             self._waste_reporter.mark_failed_scheduling_attempt(args.pod, outcome)
@@ -463,12 +503,12 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                 self._demands.create_demand_for_application_in_any_zone(
                     driver, app_resources_early
                 )
-                raise SchedulingFailure(
+                self._raise_driver_refusal(
+                    driver,
+                    app_resources_early,
                     FAILURE_EARLIER_DRIVER,
-                    self._refusal_message(
-                        "earlier drivers do not fit to the cluster",
-                        "earlier-driver",
-                    ),
+                    "earlier drivers do not fit to the cluster",
+                    "earlier-driver",
                 )
             return self._finish_driver_selection(
                 instance_group, driver, app_resources_early, outcome.result, zones
@@ -489,7 +529,7 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         packing_result = None
         self._check_deadline("fifo-gate")
         if self._is_fifo:
-            queued_drivers = self._pod_lister.list_earlier_drivers(driver)
+            queued_drivers = self._earlier_drivers(driver)
             # tpu-batch: the whole earlier-drivers pass plus this driver's
             # pack is ONE device solve (ops/fifo_solver); other policies
             # run the host loop
@@ -500,6 +540,7 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                 executor_node_names,
                 metadata,
                 app_resources,
+                current_driver=driver,
             )
             if outcome is not None and outcome.supported:
                 earlier_ok = outcome.earlier_ok
@@ -511,15 +552,16 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                     driver_node_names,
                     executor_node_names,
                     metadata,
+                    current_driver=driver,
                 )
             if not earlier_ok:
                 self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
-                raise SchedulingFailure(
+                self._raise_driver_refusal(
+                    driver,
+                    app_resources,
                     FAILURE_EARLIER_DRIVER,
-                    self._refusal_message(
-                        "earlier drivers do not fit to the cluster",
-                        "earlier-driver",
-                    ),
+                    "earlier drivers do not fit to the cluster",
+                    "earlier-driver",
                 )
 
         if packing_result is None:
@@ -554,11 +596,12 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         self._check_deadline("reservation-writeback")
         if not packing_result.has_capacity:
             self._demands.create_demand_for_application_in_any_zone(driver, app_resources)
-            raise SchedulingFailure(
+            self._raise_driver_refusal(
+                driver,
+                app_resources,
                 FAILURE_FIT,
-                self._refusal_message(
-                    "application does not fit to the cluster", "fit"
-                ),
+                "application does not fit to the cluster",
+                "fit",
             )
 
         if efficiency is None:
@@ -625,7 +668,7 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
             queue_names: Optional[List[str]] = [] if prov is not None else None
             if self._is_fifo:
                 skip_cutoff = self._fifo_skip_cutoff(instance_group)
-                for queued in self._pod_lister.list_earlier_drivers(driver):
+                for queued in self._earlier_drivers(driver):
                     try:
                         # stable AppDemand per pod version: tensor rows
                         # are computed once per app, not per request
@@ -637,7 +680,7 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                         )
                         continue
                     earlier_apps.append(demand)
-                    skip_allowed.append(queued.creation_timestamp > skip_cutoff)
+                    skip_allowed.append(self._skip_verdict(queued, driver, skip_cutoff))
                     if queue_names is not None:
                         queue_names.append(queued.name)
             if prov is not None:
@@ -711,6 +754,7 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         executor_node_names: List[str],
         metadata,
         app_resources,
+        current_driver: Optional[Pod] = None,
     ):
         """Run the FIFO pass + current pack on device when the configured
         binpacker provides a queue solver; returns None when unavailable
@@ -740,7 +784,12 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                 )
                 continue
             earlier_apps.append(demand)
-            skip_allowed.append(queued.creation_timestamp > skip_cutoff)
+            if current_driver is not None:
+                skip_allowed.append(
+                    self._skip_verdict(queued, current_driver, skip_cutoff)
+                )
+            else:
+                skip_allowed.append(queued.creation_timestamp > skip_cutoff)
             if queue_names is not None:
                 queue_names.append(queued.name)
         if prov is not None:
@@ -786,6 +835,7 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         node_names: List[str],
         executor_node_names: List[str],
         metadata,
+        current_driver: Optional[Pod] = None,
     ) -> bool:
         """resource.go:224-262: binpack every earlier driver and subtract
         its usage before considering this one."""
@@ -807,7 +857,12 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                     metadata,
                 )
                 if not packing_result.has_capacity:
-                    if self._should_skip_driver_fifo(driver, instance_group):
+                    base_skip = self._should_skip_driver_fifo(driver, instance_group)
+                    if self._policy is not None and current_driver is not None:
+                        base_skip = self._policy.skip_allowed(
+                            driver, current_driver, base_skip
+                        )
+                    if base_skip:
                         logger.debug(
                             "skipping non-fitting driver %s from FIFO: not old enough", driver.name
                         )

@@ -250,6 +250,33 @@ class SparkPodLister:
             and L.match_pod_instance_group(p, driver, self._instance_group_label)
         ]
 
+    def list_pending_drivers(self, driver: Pod) -> List[Pod]:
+        """The full pending-driver set ``driver`` competes with: same
+        filters as :meth:`list_earlier_drivers` MINUS the creation-time
+        cut (and including ``driver`` itself when pending), still
+        creation-time sorted.  The policy engine re-orders this set
+        under non-FIFO comparators; it shares ``_pending_cache`` so the
+        policy path costs no extra informer scan."""
+        rev = self._informer.selector_revision(L.SPARK_ROLE_LABEL, L.DRIVER)
+        cached_rev, pending = self._pending_cache
+        if cached_rev != rev:
+            drivers = self._informer.list(
+                label_selector={L.SPARK_ROLE_LABEL: L.DRIVER}
+            )
+            pending = [
+                p
+                for p in drivers
+                if p.node_name == "" and p.meta.deletion_timestamp is None
+            ]
+            pending.sort(key=lambda p: p.creation_timestamp)
+            self._pending_cache = (rev, pending)
+        return [
+            p
+            for p in pending
+            if p.scheduler_name == driver.scheduler_name
+            and L.match_pod_instance_group(p, driver, self._instance_group_label)
+        ]
+
     def get_driver_pod_for_executor(self, executor: Pod) -> Optional[Pod]:
         return self.get_driver_pod(
             executor.labels.get(L.SPARK_APP_ID_LABEL, ""), executor.namespace

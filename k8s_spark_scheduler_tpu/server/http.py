@@ -22,6 +22,8 @@
 - ``GET /debug/criticalpath`` — per-request latency decomposition:
   gate-queue / lock-wait / serde / solve / write-back / other
   (contention/criticalpath.py)
+- ``GET /policy/state`` — policy-engine state: priority bands, tenant
+  dominant shares, recent evictions with reasons (policy/engine.py)
 """
 
 from __future__ import annotations
@@ -215,6 +217,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_debug_contention(query)
         elif path == "/debug/criticalpath" and self.scheduler is not None:
             self._handle_debug_criticalpath(query)
+        elif path == "/policy/state" and self.scheduler is not None:
+            self._handle_policy_state()
         else:
             self._send_json(404, {"error": "not found"})
 
@@ -395,6 +399,17 @@ class _Handler(BaseHTTPRequestHandler):
         if limit:
             out["recent"] = analyzer.recent(limit=limit)
         self._send_json(200, out)
+
+    def _handle_policy_state(self) -> None:
+        """Policy-engine operator surface (policy/engine.py): configured
+        bands with observation counts, per-tenant dominant shares, and
+        the recent-evictions ring with reasons — the "who got evicted
+        and why" entry point (docs/operations.md)."""
+        engine = getattr(self.scheduler, "policy", None)
+        if engine is None:
+            self._send_json(200, {"enabled": False})
+            return
+        self._send_json(200, engine.state())
 
     def _handle_debug_schedule(self, pod_name: str) -> None:
         """Explain the last scheduling decision for a pod: the newest

@@ -70,6 +70,7 @@ class Server:
     capacity: object = None  # CapacitySampler (capacity/observatory.py)
     contention: object = None  # LockTimekeeper (contention/locktime.py)
     criticalpath: object = None  # CriticalPathAnalyzer (contention/criticalpath.py)
+    policy: object = None  # PolicyEngine (policy/engine.py)
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
@@ -308,6 +309,9 @@ class Server:
             # the journal keeps its pending (unlanded) intents on disk
             # for the next instance's failover replay
             self.resilience.journal.close()
+        if self.policy is not None:
+            # same contract for the evict journal
+            self.policy.close()
         if warm_thread is not None:
             # a healthy compile finishes in seconds; a wedged device must
             # not stall shutdown past the grace period, so give up at the
@@ -475,6 +479,29 @@ def init_server_with_clients(
             max_queue=install.capacity.max_queue,
         )
 
+    # scheduling-policy engine (policy/): priority ordering, backfill,
+    # gang-aware preemption, DRF.  None when disabled — the extender's
+    # hooks then cost one attribute check and decisions are
+    # byte-identical to pre-policy behavior.
+    policy_engine = None
+    if install.policy.enabled:
+        from ..policy import PolicyEngine
+
+        policy_engine = PolicyEngine(
+            install.policy,
+            pod_lister=pod_lister,
+            tensor_snapshot=tensor_snapshot,
+            rr_cache=rr_cache,
+            api=api,
+            journal_path=install.resilience.journal_path,
+            metrics=metrics,
+            provenance=provenance_tracker,
+        )
+        # failover: evict intents journaled by a previous instance
+        # replay exactly-once before any scheduling decision runs
+        # (mirrors rr_cache.recover_from_journal above)
+        policy_engine.recover()
+
     # extender (cmd/server.go:171-191)
     node_sorter = NodeSorter(
         install.driver_prioritized_node_label, install.executor_prioritized_node_label
@@ -504,7 +531,12 @@ def init_server_with_clients(
         resilience=resilience_kit,
         delta_solve=install.delta_solve,
         provenance=provenance_tracker,
+        policy=policy_engine,
     )
+    if policy_engine is not None:
+        # what-if victim validation rides the extender's warm
+        # delta-solve sessions (ops/deltasolve.py latest_basis)
+        policy_engine._delta_engine = extender.delta_engine
     if provenance_tracker is not None and extender.delta_engine is not None:
         # warm≠cold parity guard: every Nth warm hit re-proves the
         # session verdicts against the stateless cold solver and fires
@@ -553,6 +585,7 @@ def init_server_with_clients(
         capacity=capacity_sampler,
         contention=contention_keeper,
         criticalpath=criticalpath_analyzer,
+        policy=policy_engine,
     )
     server.reporters = ReporterSet(server)
 

@@ -22,6 +22,24 @@ runs:
    created/deleted exactly when the reference would" check in
    observable terms).
 
+When the server carries a policy engine (``Install.policy.enabled``)
+the audit widens to the policy invariants:
+
+- **I-P1** — no partial-gang eviction: an app the preemption
+  coordinator reports evicted must hold no ResourceReservation and no
+  still-bound pod (the victim unit is the whole application);
+- **I-P2** — bounded priority inversion: with a priority ordering and
+  backfill disabled, a lower-band driver never succeeds in a round
+  after a higher-band driver was refused ``failure-earlier-driver``;
+- **I-P3** — starvation freedom: backfill never jumps past a refused
+  driver older than ``starvation_age_seconds``;
+- **I-P4** — every eviction journaled: the evict journal is empty
+  post-quiesce (each committed eviction was journaled, executed, and
+  acked — a pending intent after quiesce is a lost/unacked eviction);
+
+and the FIFO F1 check becomes band-aware: within a band the queue is
+still FIFO, across bands priority order replaces arrival order.
+
 Violations accumulate in ``violations`` (the run fails its acceptance
 bar when non-empty) and are counted into the PR 1 metrics registry
 under ``sim.audit.violations``.
@@ -32,8 +50,10 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List
 
+from .. import timesource
 from ..demands.manager import pod_name_from_demand
 from ..scheduler import invariants
+from ..scheduler import labels as L
 from ..scheduler.extender import FAILURE_EARLIER_DRIVER
 from ..types.objects import Demand, Pod, ResourceReservation
 
@@ -48,12 +68,16 @@ class Decision:
     created: float
     outcome: str  # success | the failure-* outcomes
     node: str = ""
+    # policy runs only: the driver's priority band (policy/classes.py)
+    band: str = ""
+    band_rank: int = 0
 
 
 class Auditor:
     def __init__(self, server, metrics=None):
         self._server = server
         self._metrics = metrics if metrics is not None else server.metrics
+        self._policy = getattr(server, "policy", None)
         self.violations: List[str] = []
         self.events_audited = 0
 
@@ -74,6 +98,12 @@ class Auditor:
                 self._violate(
                     f"F0[{label}]: round attempted {group} drivers out of arrival order: {keys}"
                 )
+            ordering = (
+                self._policy.config.ordering if self._policy is not None else "fifo"
+            )
+            if ordering != "fifo":
+                self._check_policy_round(group, ds, label)
+                continue
             blocked_behind_earlier = None
             for d in ds:
                 if d.outcome == FAILURE_EARLIER_DRIVER and blocked_behind_earlier is None:
@@ -85,6 +115,39 @@ class Auditor:
                         f"{group}) was refused with failure-earlier-driver"
                     )
 
+    def _check_policy_round(self, group: str, ds: List[Decision], label: str) -> None:
+        """Band-aware ordering audit for non-FIFO policy orderings.
+        Within a band the queue is still FIFO; across bands a
+        higher-band success after a lower-band refusal is the POINT of
+        priority ordering, while the reverse is an inversion — legal
+        only through the conservative backfill probe (I-P2), and never
+        past the refused driver's starvation age (I-P3)."""
+        cfg = self._policy.config
+        refused: List[Decision] = []
+        for d in ds:
+            if d.outcome == FAILURE_EARLIER_DRIVER:
+                refused.append(d)
+                continue
+            if d.outcome != "success":
+                continue
+            for r in refused:
+                if d.band_rank > r.band_rank:
+                    continue  # priority order doing its job
+                if not cfg.backfill:
+                    kind = "F1" if d.band_rank == r.band_rank else "I-P2"
+                    self._violate(
+                        f"{kind}[{label}]: driver {d.pod_name} (band {d.band}) "
+                        f"succeeded after driver {r.pod_name} (band {r.band}, "
+                        f"group {group}) was refused failure-earlier-driver "
+                        f"with backfill disabled"
+                    )
+                elif timesource.now() - r.created >= cfg.starvation_age_seconds:
+                    self._violate(
+                        f"I-P3[{label}]: backfill admitted {d.pod_name} (band "
+                        f"{d.band}) past {r.pod_name} (band {r.band}), which has "
+                        f"been starving for >= {cfg.starvation_age_seconds}s"
+                    )
+
     # -- per-event state checks ----------------------------------------------
 
     def check_state(self, label: str) -> None:
@@ -94,6 +157,7 @@ class Auditor:
             self._violate(f"{v} [{label}]")
         self._check_demand_hygiene(label)
         self._check_lost_intents(label)
+        self._check_policy_state(label)
         self._metrics.gauge("sim.audit.events", float(self.events_audited))
 
     def _check_demand_hygiene(self, label: str) -> None:
@@ -141,6 +205,41 @@ class Auditor:
                 f"J2[{label}]: reservation {key} deleted locally but still at "
                 f"the API server with no journaled delete (lost intent)"
             )
+
+    def _check_policy_state(self, label: str) -> None:
+        """I-P1 + I-P4 against quiesced state.  Runs BEFORE the
+        runner's eviction reap, so a partial eviction cannot be masked
+        by the sim's own cleanup."""
+        engine = self._policy
+        if engine is None or engine.coordinator is None:
+            return
+        st = engine.coordinator.state()
+        if st["journalDepth"] != 0:
+            self._violate(
+                f"I-P4[{label}]: {st['journalDepth']} evict intents still "
+                f"pending post-quiesce (eviction executed without ack, or "
+                f"journaled and never executed)"
+            )
+        evicted = {(e["namespace"], e["app"]) for e in st["recent"]}
+        if not evicted:
+            return
+        rr_keys = {
+            (rr.namespace, rr.name)
+            for rr in self._server.resource_reservation_cache.list()
+        }
+        for key in sorted(evicted & rr_keys):
+            self._violate(
+                f"I-P1[{label}]: evicted app {key} still holds a "
+                f"ResourceReservation (partial-gang eviction)"
+            )
+        evicted_apps = {app for _, app in evicted}
+        for pod in self._server.api.list(Pod.KIND):
+            app = pod.labels.get(L.SPARK_APP_ID_LABEL, "")
+            if app in evicted_apps and pod.node_name:
+                self._violate(
+                    f"I-P1[{label}]: pod {pod.name} of evicted app {app} is "
+                    f"still bound to {pod.node_name} (partial-gang eviction)"
+                )
 
     def _violate(self, message: str) -> None:
         self.violations.append(message)

@@ -94,6 +94,13 @@ class Simulation:
         self._pumps_scheduled: set = set()
         self.harness: Optional[Harness] = None
         self.auditor: Optional[Auditor] = None
+        # policy engine bookkeeping (sc.policy non-empty): resolved
+        # config, storm-app counter, evictions mirrored into _App
+        # state, per-band driver decision counts
+        self._policy_cfg = None
+        self._storm_idx = 0
+        self._evictions_reaped = 0
+        self._band_outcomes: Dict[str, Dict[str, int]] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -138,9 +145,24 @@ class Simulation:
         # the harness enables the detector before wiring the server, and
         # chaos tests assert zero reports after the run
         racecheck.enable_if_env()
+        extra_install = None
+        if sc.policy:
+            # thread the scenario's policy block into the REAL wiring:
+            # the harness builds the same Install it would by default,
+            # plus the policy engine (server/wiring.py)
+            from ..config import FifoConfig, Install, PolicyConfig
+
+            self._policy_cfg = PolicyConfig.from_dict(sc.policy)
+            extra_install = Install(
+                fifo=sc.fifo,
+                fifo_config=FifoConfig(),
+                binpack_algo=sc.binpack_algo,
+                policy=self._policy_cfg,
+            )
         self.harness = Harness(
             binpack_algo=sc.binpack_algo,
             is_fifo=sc.fifo,
+            extra_install=extra_install,
             # the marker thread would mutate pod conditions at wall-clock
             # instants (nondeterministic vs the event stream); scans are
             # sim-driven via unschedulable_scan_interval instead
@@ -212,6 +234,10 @@ class Simulation:
     # -- event handlers -------------------------------------------------------
 
     def _on_arrival(self, spec: AppSpec) -> None:
+        self._submit_app(spec)
+        self._process(f"arrival:{spec.app_id}", self._round(f"arrival:{spec.app_id}"))
+
+    def _submit_app(self, spec: AppSpec) -> None:
         h = self.harness
         if spec.dynamic:
             pods = h.dynamic_allocation_spark_pods(
@@ -239,11 +265,18 @@ class Simulation:
                 creation_timestamp=self.clock.now(),
             )
         driver, executors = pods[0], pods[1:]
+        if self._policy_cfg is not None:
+            # policy inputs ride on labels, exactly as production pods
+            # would carry them (executor template keeps them so
+            # replacements stay attributable)
+            for pod in pods:
+                pod.labels[self._policy_cfg.band_label] = spec.band
+                if spec.tenant:
+                    pod.labels[self._policy_cfg.tenant_label] = spec.tenant
         app = _App(spec=spec, driver_name=driver.name)
         app.executor_template = executors[0].deepcopy() if executors else None
         self._apps[spec.app_id] = app
         h.create_pod(driver)
-        self._process(f"arrival:{spec.app_id}", self._round(f"arrival:{spec.app_id}"))
 
     def _on_tick(self) -> None:
         fulfilled = self._pump_autoscaler()
@@ -308,6 +341,8 @@ class Simulation:
             self._fault_apiserver(fault, mode="latency")
         elif fault.kind == "kernel_fault":
             self._fault_kernel(fault)
+        elif fault.kind == "priority_storm":
+            self._fault_priority_storm(fault)
         self._process(label, self._round(label))
 
     def _fault_node_kill(self, fault: FaultSpec) -> None:
@@ -461,6 +496,32 @@ class Simulation:
             lambda: ops_registry.set_kernel_fault_hook(None),
         )
 
+    def _fault_priority_storm(self, fault: FaultSpec) -> None:
+        """Burst of ``count`` fresh applications in the fault's band at
+        the fault instant: on a saturated cluster, the queue-jump +
+        gang-atomic-preemption pressure shape the policy engine exists
+        for.  Shapes draw from the scenario's workload ranges off the
+        fault rng, so the storm is deterministic under the seed."""
+        sc = self.scenario
+        wl = sc.workload
+        exec_lo = int(wl.get("executors", {}).get("min", 1))
+        exec_hi = int(wl.get("executors", {}).get("max", 4))
+        life_lo = float(wl.get("lifetime", {}).get("min", 60.0))
+        life_hi = float(wl.get("lifetime", {}).get("max", 600.0))
+        for _ in range(max(fault.count, 1)):
+            self._storm_idx += 1
+            count = self._rng.randint(exec_lo, exec_hi)
+            spec = AppSpec(
+                app_id=f"storm-{self._storm_idx:03d}",
+                arrival=self.clock.now() - SIM_EPOCH,
+                executor_count=count,
+                min_executor_count=count,
+                lifetime=round(self._rng.uniform(life_lo, life_hi), 3),
+                instance_group=wl.get("instance_group", sc.cluster.instance_group),
+                band=fault.band,
+            )
+            self._submit_app(spec)
+
     def _kill_app(self, app_id: str) -> None:
         app = self._apps.get(app_id)
         h = self.harness
@@ -535,6 +596,16 @@ class Simulation:
                 msg = next(iter(result.failed_nodes.values()))
                 outcome = self._classify_failure(msg)
             group = pod.node_affinity.get(ig_label) or [""]
+            band, band_rank = "", 0
+            if self._policy_cfg is not None and role == "driver":
+                band = pod.labels.get(
+                    self._policy_cfg.band_label, self._policy_cfg.default_band
+                )
+                band_rank = self._policy_cfg.bands.get(band, 0)
+                bucket = self._band_outcomes.setdefault(
+                    band, {"success": 0, "refused": 0}
+                )
+                bucket["success" if outcome == "success" else "refused"] += 1
             decisions.append(
                 Decision(
                     pod_name=pod.name,
@@ -543,6 +614,8 @@ class Simulation:
                     created=pod.creation_timestamp,
                     outcome=outcome,
                     node=result.node_names[0] if result.node_names else "",
+                    band=band,
+                    band_rank=band_rank,
                 )
             )
             return outcome
@@ -640,6 +713,7 @@ class Simulation:
         self._quiesce(label)
         self.auditor.check_round(decisions, label)
         self.auditor.check_state(label)
+        self._reap_evictions()
         self._fire_invariant_trigger(label)
         self._schedule_scaler_pumps()
         self._sample_capacity(label)
@@ -675,6 +749,28 @@ class Simulation:
             entry["packing_efficiency"] = round(eff, 6)
         self._seq += 1
         self._log.append(entry)
+
+    def _reap_evictions(self) -> None:
+        """Mirror policy evictions into the sim's app bookkeeping: the
+        coordinator already deleted the victim's bound pods + RR; clean
+        up its still-pending pods and mark the app evicted so
+        completions and later rounds track post-eviction truth.  Runs
+        AFTER the auditor's policy checks — the reap must never mask a
+        partial-gang eviction from I-P1."""
+        engine = getattr(self.harness.server, "policy", None)
+        if engine is None or engine.coordinator is None:
+            return
+        st = engine.coordinator.state()
+        fresh = st["evictionsTotal"] - self._evictions_reaped
+        if fresh <= 0:
+            return
+        self._evictions_reaped = st["evictionsTotal"]
+        for ev in list(st["recent"])[-fresh:]:
+            app_id = ev["app"]
+            self._kill_app(app_id)
+            app = self._apps.get(app_id)
+            if app is not None:
+                app.state = "evicted"
 
     def _audit_only(self, label: str) -> None:
         self._quiesce(label)
@@ -843,6 +939,7 @@ class Simulation:
                 "running_at_end": states.count("running"),
                 "pending_at_end": states.count("pending"),
                 "killed": states.count("dead"),
+                "evicted": states.count("evicted"),
             },
             "queue_depth": {
                 "max": max(self._queue_depths, default=0),
@@ -869,6 +966,9 @@ class Simulation:
         summary["capacity"] = self._capacity_summary()
         summary["waste_phases"] = self._waste_summary()
         summary["contention"] = self._contention_summary()
+        policy = self._policy_summary()
+        if policy is not None:
+            summary["policy"] = policy
         sampler = getattr(self.harness.server, "capacity", None) if self.harness else None
         timeline = (
             [s.to_dict() for s in sampler.timeline()] if sampler is not None else []
@@ -880,6 +980,51 @@ class Simulation:
             violations=list(self.auditor.violations) if self.auditor else [],
             capacity_timeline=timeline,
         )
+
+    def _policy_summary(self) -> Optional[Dict]:
+        """Eviction scorecard: who got evicted and why, per-band driver
+        decision counts, DRF tenant shares — the policy/ columns of the
+        sim summary.  Summary-only; the digest never sees it (whatif
+        timings are wall-clock in production runs)."""
+        engine = (
+            getattr(self.harness.server, "policy", None)
+            if self.harness is not None
+            else None
+        )
+        if engine is None:
+            return None
+        st = engine.state()
+        out: Dict = {
+            "ordering": st["ordering"],
+            "backfill": st["backfill"],
+            "preemption_enabled": st["preemptionEnabled"],
+            "bands": st["bands"],
+            "band_outcomes": {
+                b: dict(c) for b, c in sorted(self._band_outcomes.items())
+            },
+            "tenants": st["tenants"],
+        }
+        pre = st.get("preemption")
+        if pre is not None:
+            out["evictions"] = {
+                "total": pre["evictionsTotal"],
+                "victims": pre["victimsTotal"],
+                "journal_depth": pre["journalDepth"],
+                "whatif": pre.get("whatif", {}),
+                "scorecard": [
+                    {
+                        "app": ev["app"],
+                        "band": ev["band"],
+                        "tenant": ev["tenant"],
+                        "pods": ev["pods"],
+                        "reason": ev["reason"],
+                        "replayed": ev["replayed"],
+                        "at": round(ev["at"] - SIM_EPOCH, 3),
+                    }
+                    for ev in pre["recent"]
+                ],
+            }
+        return out
 
     def _contention_summary(self) -> Optional[Dict]:
         """Contention scorecard columns: the extender predicate lock's

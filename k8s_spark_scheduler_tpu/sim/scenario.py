@@ -53,7 +53,16 @@ Fault catalog (all deterministic under the scenario seed):
 - ``kernel_fault``: for ``duration`` virtual seconds every device
   kernel lane dispatch raises, driving lane demotion to the host path
   and, after the window + cooloff, re-probe and promotion
-  (resilience/lanehealth.py).
+  (resilience/lanehealth.py);
+- ``priority_storm``: submit ``count`` fresh applications in the
+  fault's ``band`` (default ``high``) at the fault instant — on a
+  saturated cluster this exercises the policy engine's queue-jumping
+  and gang-atomic preemption path (policy/).
+
+A scenario may also carry a ``policy`` dict (the ``Install.policy``
+kebab-case keys from ``config.PolicyConfig.from_dict``); when present
+the simulator wires the full policy engine into the harness and the
+auditor arms the I-P1..I-P4 policy invariants.
 """
 
 from __future__ import annotations
@@ -71,6 +80,7 @@ FAULT_KINDS = {
     "apiserver_outage",
     "apiserver_latency",
     "kernel_fault",
+    "priority_storm",
 }
 
 
@@ -104,6 +114,8 @@ class FaultSpec:
     # window length (virtual seconds) for the windowed faults:
     # apiserver_outage / apiserver_latency / kernel_fault
     duration: float = 60.0
+    # priority band stamped onto priority_storm submissions
+    band: str = "high"
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -126,6 +138,9 @@ class Scenario:
     faults: List[FaultSpec] = field(default_factory=list)
     # deterministic unschedulable-marker sweeps (0 disables)
     unschedulable_scan_interval: float = 0.0
+    # Install.policy overrides (kebab-case, PolicyConfig.from_dict);
+    # empty = policy engine disabled, byte-identical FIFO
+    policy: Dict = field(default_factory=dict)
 
     @staticmethod
     def from_dict(d: Dict) -> "Scenario":
@@ -133,7 +148,7 @@ class Scenario:
         unknown = set(d) - {
             "name", "seed", "duration", "retry_interval", "binpack_algo",
             "fifo", "cluster", "workload", "autoscaler", "faults",
-            "unschedulable_scan_interval",
+            "unschedulable_scan_interval", "policy",
         }
         if unknown:
             raise ValueError(f"unknown scenario keys: {sorted(unknown)}")

@@ -43,6 +43,10 @@ class AppSpec:
     executor_mem: str = "1Gi"
     instance_group: str = "batch-medium-priority"
     namespace: str = "default"
+    # policy-engine inputs (policy/): priority band + fair-share tenant.
+    # Defaults keep pre-policy traces replaying bit-identically.
+    band: str = "normal"
+    tenant: str = ""
 
     def to_dict(self) -> Dict:
         return asdict(self)
@@ -82,12 +86,24 @@ class WorkloadGenerator:
         life_lo = float(spec.get("lifetime", {}).get("min", 60.0))
         life_hi = float(spec.get("lifetime", {}).get("max", 600.0))
         instance_group = spec.get("instance_group", "batch-medium-priority")
+        # optional policy-shape knobs: "band_weights" {band: weight}
+        # draws a band per app, "tenants" [name, ...] draws a tenant —
+        # both off the same seeded rng so the trace stays deterministic
+        band_weights = dict(spec.get("band_weights", {}))
+        band_names = sorted(band_weights)
+        tenants = list(spec.get("tenants", []))
         apps: List[AppSpec] = []
         for i, t in enumerate(arrivals):
             count = rng.randint(exec_lo, exec_hi)
             dynamic = rng.random() < dyn_frac
             min_count = rng.randint(max(1, count // 2), count) if dynamic else count
             sizes = rng.choice(_SIZE_MENU)
+            band = spec.get("band", "normal")
+            if band_names:
+                band = rng.choices(
+                    band_names, weights=[band_weights[b] for b in band_names]
+                )[0]
+            tenant = rng.choice(tenants) if tenants else spec.get("tenant", "")
             apps.append(
                 AppSpec(
                     app_id=f"app-{i:04d}",
@@ -101,6 +117,8 @@ class WorkloadGenerator:
                     executor_cpu=sizes[2],
                     executor_mem=sizes[3],
                     instance_group=instance_group,
+                    band=band,
+                    tenant=tenant,
                 )
             )
         return apps

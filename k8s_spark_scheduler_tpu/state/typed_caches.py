@@ -122,6 +122,12 @@ class ResourceReservationCache:
 
         enqueued = 0
         for intent in self._journal.pending():
+            if intent.get("kind") not in (None, ResourceReservation.KIND):
+                # defense: a journal file shared with another intent
+                # class (e.g. policy evictions) must not be replayed as
+                # reservation writes — foreign kinds are left pending
+                # for their own recoverer
+                continue
             key = (intent["ns"], intent["name"])
             op = intent["op"]
             existing = self._store.get(key)

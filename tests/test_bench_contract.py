@@ -55,6 +55,16 @@ def test_bench_final_line_is_the_headline(tmp_path):
     assert "fingerprint" in artifact["host"]
     assert artifact["shape"] == {"nodes": 120, "apps": 12, "chain": 2, "rounds": 2}
 
+    # preemption what-if contract (ISSUE 14): the policy engine's victim
+    # validation is the solver's admission rule on avail + freed; it is
+    # pure numpy (the no-warm-session fallback), so the lane is
+    # unconditional and its per-call p50 is pinned in the artifact
+    pw = artifact["lanes"].get("preemption-whatif cpu")
+    assert pw is not None, "no preemption-whatif lane"
+    assert pw["gangs"] == 16
+    assert pw["whatif_p50_ms"] > 0
+    assert pw["rounds"] >= 16  # per-call samples: gangs x reps
+
     # VERDICT r4 #2: a metric named p99_filter_latency… must be the
     # request-level number measured at the HTTP boundary — pinned to the
     # config5-e2e lane's own stats, with its sample count carried in the

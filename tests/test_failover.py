@@ -421,3 +421,110 @@ def test_leader_failover_new_instance_rebuilds_state():
             h.close()
         except Exception:
             pass
+
+
+def test_evict_journal_replays_exactly_once_across_failover(tmp_path):
+    """A leader that journaled a policy-eviction intent but died before
+    executing it (crash between journal and ack) hands the eviction to
+    the next instance: wiring's ``policy_engine.recover()`` replays the
+    pending intent at boot, each victim pod is deleted at the API server
+    EXACTLY once, the evict journal drains, and a third instance (journal
+    empty) deletes nothing (policy/preempt.py I-P4)."""
+    from k8s_spark_scheduler_tpu.config import (
+        Install,
+        PolicyConfig,
+        ResilienceConfig,
+    )
+    from k8s_spark_scheduler_tpu.kube.errors import NotFoundError
+    from k8s_spark_scheduler_tpu.policy.preempt import EVICT_KIND
+    from k8s_spark_scheduler_tpu.server.wiring import init_server_with_clients
+
+    journal_path = str(tmp_path / "intents.jsonl")
+
+    def install():
+        return Install(
+            fifo=True,
+            binpack_algo="tightly-pack",
+            resilience=ResilienceConfig(journal_path=journal_path),
+            policy=PolicyConfig(
+                enabled=True,
+                ordering="priority-then-fifo",
+                preemption_enabled=True,
+            ),
+        )
+
+    h = Harness(extra_install=install())
+    pod_deletes = {}
+    real_delete = h.api.delete
+
+    def counting_delete(kind, namespace, name):
+        real_delete(kind, namespace, name)  # raises NotFoundError on miss
+        if kind == "Pod":
+            pod_deletes[name] = pod_deletes.get(name, 0) + 1
+
+    second = third = None
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        nodes = ["n1", "n2"]
+        victims = h.static_allocation_spark_pods("app-victim", 2)
+        for p in victims:
+            p.labels["spark-priority-band"] = "low"
+        for p in victims:
+            h.assert_success(h.schedule(p, nodes))
+        h.wait_quiesced()
+        victim_pods = [p.name for p in victims]
+
+        # crash mid-eviction: the old leader journals the intent for a
+        # committed victim plan, then dies before executing any delete
+        h.server.policy.coordinator._journal.record(
+            "delete",
+            EVICT_KIND,
+            "default",
+            "app-victim",
+            {
+                "pods": victim_pods,
+                "reason": "preempted by app-high (band high, numpy what-if)",
+                "preemptor": "app-high",
+                "band": "low",
+                "tenant": "default",
+            },
+        )
+        h.server.stop()
+        assert pod_deletes == {}
+        h.api.delete = counting_delete
+
+        # new leader: recover() replays the intent before serving
+        second = init_server_with_clients(h.api, install(), demand_poll_interval=0.02)
+        assert second.policy.coordinator.journal_depth() == 0
+        for name in victim_pods:
+            with pytest.raises(NotFoundError):
+                h.api.get("Pod", "default", name)
+        assert pod_deletes == {name: 1 for name in victim_pods}
+        assert h.wait_for_api(
+            lambda: h.api.list("ResourceReservation") == []
+        )
+        recent = second.policy.coordinator.state()["recent"]
+        assert [(e["app"], e["replayed"]) for e in recent] == [("app-victim", True)]
+        assert recent[0]["reason"].startswith("preempted by app-high")
+        second.stop()
+        deletes_after_second = dict(pod_deletes)
+
+        # a third instance sees an empty evict journal: zero deletes
+        third = init_server_with_clients(h.api, install(), demand_poll_interval=0.02)
+        assert third.policy.coordinator.journal_depth() == 0
+        assert third.policy.coordinator.state()["recent"] == []
+        time.sleep(0.2)  # let any (wrong) replay surface
+        assert pod_deletes == deletes_after_second
+    finally:
+        h.api.delete = real_delete
+        for server in (second, third):
+            if server is not None:
+                try:
+                    server.stop()
+                except Exception:
+                    pass
+        try:
+            h.close()
+        except Exception:
+            pass

@@ -528,6 +528,66 @@ def test_locktime_enabled_overhead_within_budget():
         h.close()
 
 
+def test_policy_engine_overhead_within_budget():
+    """ISSUE 14 acceptance: with ``policy.enabled=false`` the Filter
+    path must carry NO policy cost — structurally the engine is never
+    constructed (``extender._policy is None``; every hook is one None
+    check), and measurably an engine running the fifo ordering stays
+    within disabled × 1.05 plus absolute CI-noise slack (same pattern
+    as the provenance/locktime guards)."""
+    from k8s_spark_scheduler_tpu.config import FifoConfig, Install, PolicyConfig
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    # structural half: the default install constructs no engine at all
+    h0 = Harness(is_fifo=True)
+    try:
+        assert h0.server.extender._policy is None
+        assert getattr(h0.server, "policy", None) is None
+    finally:
+        h0.close()
+
+    # measured half: fifo-ordering engine vs the engine detached
+    install = Install(
+        fifo=True,
+        fifo_config=FifoConfig(),
+        policy=PolicyConfig(enabled=True, ordering="fifo"),
+    )
+    h = Harness(is_fifo=True, extra_install=install)
+    try:
+        extender = h.server.extender
+        assert extender._policy is not None
+        h.new_node("n1")
+        h.new_node("n2")
+        driver = h.static_allocation_spark_pods("app-pol-perf", 1)[0]
+        h.assert_success(h.schedule(driver, ["n1", "n2"]))  # creates the RR
+        args = ExtenderArgs(pod=driver, node_names=["n1", "n2"])
+        n = 50
+
+        def batch():
+            for _ in range(n):
+                extender.predicate(args)
+
+        engine = extender._policy
+        batch()  # warm caches/jit on the enabled path
+        extender._policy = None
+        try:
+            disabled_s = _best_of(batch)
+        finally:
+            extender._policy = engine
+        batch()  # warm the enabled path again
+        enabled_s = _best_of(batch)
+
+        budget = disabled_s * 1.05 + n * 0.5e-3  # 5% relative + 0.5ms/request
+        assert enabled_s <= budget, (
+            f"policy-engine overhead: {enabled_s * 1e3:.2f}ms per {n}-request "
+            f"batch with the fifo-ordering engine vs {disabled_s * 1e3:.2f}ms "
+            f"detached (budget {budget * 1e3:.2f}ms)"
+        )
+    finally:
+        h.close()
+
+
 def test_predicate_latency_with_tracing_within_budget():
     from k8s_spark_scheduler_tpu.testing.harness import Harness
 

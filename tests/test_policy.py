@@ -1,0 +1,333 @@
+"""Policy-engine tests (policy/): the disabled engine is
+decision-identical to the bare FIFO extender, preemption evicts whole
+gangs only (I-P1), DRF accounting tracks tenants off the RR change
+feed, and /policy/state serves the operator view."""
+
+import time
+
+import pytest
+
+from k8s_spark_scheduler_tpu.config import FifoConfig, Install, PolicyConfig
+from k8s_spark_scheduler_tpu.kube.errors import NotFoundError
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types.objects import Pod
+
+BAND_LABEL = "spark-priority-band"
+TENANT_LABEL = "spark-tenant"
+
+
+def _policy_install(**overrides) -> Install:
+    """An Install identical to the default Harness wiring except for
+    the policy block — the property test depends on everything else
+    matching the bare-Harness install exactly."""
+    return Install(
+        fifo=True,
+        fifo_config=FifoConfig(),
+        binpack_algo="tightly-pack",
+        policy=PolicyConfig(enabled=True, **overrides),
+    )
+
+
+def _pod_gone(h: Harness, name: str, namespace: str = "default") -> bool:
+    try:
+        h.api.get(Pod.KIND, namespace, name)
+        return False
+    except NotFoundError:
+        return True
+
+
+# -- decision identity (the PolicyConfig.enabled=False / ordering=fifo
+#    contract pinned by ISSUE 14's acceptance criteria) -----------------
+
+
+def _seeded_workload(seed: int):
+    """Deterministic node + app specs from the seed: varied sizes so
+    some apps fit, some hit failure-fit, and the refused ones gate
+    later drivers through failure-earlier-driver."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    nodes = [
+        (f"n{i}", str(int(rng.randint(4, 9))), f"{int(rng.randint(4, 9))}Gi")
+        for i in range(3)
+    ]
+    apps = [
+        (
+            f"app-{seed}-{i}",
+            int(rng.randint(0, 4)),
+            str(int(rng.randint(1, 3))),
+        )
+        for i in range(6)
+    ]
+    return nodes, apps
+
+
+def _run_workload(h: Harness, seed: int):
+    """Schedule the seeded workload and record every decision verbatim:
+    (pod name, granted nodes, full FailedNodes map)."""
+    nodes, apps = _seeded_workload(seed)
+    for name, cpu, mem in nodes:
+        h.new_node(name, cpu=cpu, memory=mem)
+    node_names = [n[0] for n in nodes]
+    decisions = []
+    for i, (app_id, executor_count, executor_cpu) in enumerate(apps):
+        pods = h.static_allocation_spark_pods(
+            app_id,
+            executor_count,
+            executor_cpu=executor_cpu,
+            creation_timestamp=1000.0 + i,
+        )
+        for pod in pods:
+            result = h.schedule(pod, node_names)
+            decisions.append(
+                (
+                    pod.name,
+                    tuple(result.node_names or ()),
+                    tuple(sorted((result.failed_nodes or {}).items())),
+                )
+            )
+    return decisions
+
+
+@pytest.mark.parametrize("seed", [11, 23, 37, 41, 59])
+def test_policy_fifo_is_decision_identical_to_no_engine(seed):
+    """Property test: the policy engine with ordering=fifo (and the
+    default enabled=False wiring, which constructs no engine at all)
+    produces byte-identical decisions to the bare FIFO extender over a
+    seeded random workload — same granted nodes, same FailedNodes
+    messages, pod for pod."""
+    bare = Harness()
+    try:
+        baseline = _run_workload(bare, seed)
+        assert bare.server.policy is None
+        assert bare.server.extender._policy is None
+    finally:
+        bare.close()
+
+    with_engine = Harness(extra_install=_policy_install(ordering="fifo"))
+    try:
+        engine_decisions = _run_workload(with_engine, seed)
+        assert with_engine.server.policy is not None
+    finally:
+        with_engine.close()
+
+    assert engine_decisions == baseline
+
+
+# -- gang-aware preemption through the extender ------------------------
+
+
+def test_preemption_evicts_whole_gang_and_admits_preemptor():
+    """A refused high-band driver triggers a what-if-validated eviction
+    of the WHOLE low-band app (every pod + its RR, never a subset), the
+    refusal message names the victims, and the retry admits the
+    preemptor gang."""
+    install = _policy_install(
+        ordering="priority-then-fifo", preemption_enabled=True
+    )
+    h = Harness(extra_install=install)
+    try:
+        h.new_node("n1", cpu="4", memory="4Gi")
+        h.new_node("n2", cpu="4", memory="4Gi")
+        nodes = ["n1", "n2"]
+
+        # the low-band app holds 6 of the cluster's 8 CPUs
+        low = h.static_allocation_spark_pods("app-low", 5)
+        for pod in low:
+            pod.labels[BAND_LABEL] = "low"
+        for pod in low:
+            h.assert_success(h.schedule(pod, nodes))
+        h.wait_quiesced()
+        assert h.get_resource_reservation("app-low") is not None
+
+        # the high-band gang needs 5 CPUs; only 2 remain -> failure-fit,
+        # and the policy engine commits the eviction inside the refusal
+        high = h.static_allocation_spark_pods("app-high", 4)
+        for pod in high:
+            pod.labels[BAND_LABEL] = "high"
+        result = h.schedule(high[0], nodes)
+        h.assert_failure(result)
+        messages = "; ".join(result.failed_nodes.values())
+        assert "preempting victims: app-low" in messages
+
+        # I-P1: the victim gang goes atomically — every pod AND the RR
+        assert h.wait_for_api(
+            lambda: all(_pod_gone(h, p.name) for p in low)
+        ), "victim pods not fully evicted"
+        assert h.wait_for_api(
+            lambda: h.get_resource_reservation("app-low") is None
+        )
+
+        # the journal drained (exactly-once bookkeeping, I-P4) and the
+        # eviction is attributed in the operator state
+        engine = h.server.policy
+        assert h.wait_for_api(lambda: engine.coordinator.journal_depth() == 0)
+        state = engine.state()
+        recent = state["preemption"]["recent"]
+        assert [e["app"] for e in recent] == ["app-low"]
+        assert recent[0]["pods"] == len(low)  # the WHOLE gang, counted
+        assert recent[0]["replayed"] is False
+        assert state["preemption"]["whatif"]["validated"] >= 1
+
+        # the preemptor gang now fits
+        h.wait_quiesced()
+        for pod in high:
+            h.assert_success(h.schedule(pod, nodes))
+        assert h.get_resource_reservation("app-high") is not None
+    finally:
+        h.close()
+
+
+def test_no_partial_eviction_when_whole_gang_cannot_help():
+    """When even evicting the entire low-band app cannot fit the
+    preemptor, NOTHING is evicted — a partial gang eviction (freeing
+    some pods "to get closer") is impossible by construction."""
+    install = _policy_install(
+        ordering="priority-then-fifo", preemption_enabled=True
+    )
+    h = Harness(extra_install=install)
+    try:
+        h.new_node("n1", cpu="4", memory="4Gi")
+        h.new_node("n2", cpu="4", memory="4Gi")
+        nodes = ["n1", "n2"]
+
+        low = h.static_allocation_spark_pods("app-low", 5)
+        for pod in low:
+            pod.labels[BAND_LABEL] = "low"
+        for pod in low:
+            h.assert_success(h.schedule(pod, nodes))
+        h.wait_quiesced()
+
+        # 10 CPUs > the 8-CPU cluster: infeasible even on an empty basis
+        huge = h.static_allocation_spark_pods("app-huge", 8, driver_cpu="2")
+        for pod in huge:
+            pod.labels[BAND_LABEL] = "high"
+        result = h.schedule(huge[0], nodes)
+        h.assert_failure(result)
+        assert "preempting victims" not in "; ".join(result.failed_nodes.values())
+
+        # the what-if solve rejected every candidate set: zero evictions
+        time.sleep(0.05)
+        for pod in low:
+            assert not _pod_gone(h, pod.name)
+        assert h.get_resource_reservation("app-low") is not None
+        engine = h.server.policy
+        assert engine.state()["preemption"]["evictionsTotal"] == 0
+    finally:
+        h.close()
+
+
+# -- DRF fair share ----------------------------------------------------
+
+
+def test_drf_accounting_tracks_tenants_off_rr_feed():
+    """Scheduling apps under different tenant labels books per-tenant
+    dominant shares off the RR change feed; the heavier tenant crosses
+    the equal split and shows up in the over-share (preemptible) set."""
+    install = _policy_install(ordering="drf")
+    h = Harness(extra_install=install)
+    try:
+        h.new_node("n1")  # 8 CPU / 8Gi / 1 GPU each
+        h.new_node("n2")
+        nodes = ["n1", "n2"]
+
+        heavy = h.static_allocation_spark_pods("app-heavy", 8)
+        for pod in heavy:
+            pod.labels[TENANT_LABEL] = "team-a"
+        light = h.static_allocation_spark_pods("app-light", 1)
+        for pod in light:
+            pod.labels[TENANT_LABEL] = "team-b"
+        for pod in heavy:
+            h.assert_success(h.schedule(pod, nodes))
+        for pod in light:
+            h.assert_success(h.schedule(pod, nodes))
+        h.wait_quiesced()
+
+        engine = h.server.policy
+        state = engine.drf.state()
+        assert set(state) == {"team-a", "team-b"}
+        # 9 of 16 CPUs vs 2 of 16; the dominant resource is CPU here
+        assert state["team-a"]["dominantShare"] == pytest.approx(9 / 16)
+        assert state["team-b"]["dominantShare"] == pytest.approx(2 / 16)
+        assert state["team-a"]["fairShare"] == pytest.approx(0.5)
+
+        over = engine.drf.over_share_tenants()
+        assert set(over) == {"team-a"}
+
+        # deleting the heavy app's RR (app teardown) releases its share:
+        # the accountant rides the change feed, no polling involved
+        h.server.resource_reservation_cache.delete("default", "app-heavy")
+        assert h.wait_for_api(
+            lambda: set(engine.drf.state()) == {"team-b"}
+        )
+        assert engine.drf.over_share_tenants() == {}
+    finally:
+        h.close()
+
+
+# -- the operator endpoint ---------------------------------------------
+
+
+def test_policy_state_endpoint_over_http():
+    """GET /policy/state serves the full engine state when the policy
+    engine is wired, and the explicit ``{"enabled": false}`` shape when
+    it is not — the operator's first stop in the eviction runbook."""
+    import json
+    import urllib.request
+
+    from k8s_spark_scheduler_tpu.server.http import ExtenderHTTPServer
+
+    def get_state(port):
+        url = f"http://127.0.0.1:{port}/policy/state"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            return json.loads(resp.read())
+
+    h = Harness(
+        extra_install=_policy_install(
+            ordering="priority-then-fifo", preemption_enabled=True
+        )
+    )
+    http = None
+    try:
+        http = ExtenderHTTPServer(h.server, port=0)
+        http.start()
+        h.new_node("n1")
+        pods = h.static_allocation_spark_pods("app-state", 1)
+        for pod in pods:
+            pod.labels[BAND_LABEL] = "high"
+            pod.labels[TENANT_LABEL] = "team-a"
+        for pod in pods:
+            h.assert_success(h.schedule(pod, ["n1"]))
+        h.wait_quiesced()
+
+        state = get_state(http.port)
+        assert state["enabled"] is True
+        assert state["ordering"] == "priority-then-fifo"
+        assert state["preemptionEnabled"] is True
+        assert state["bands"]["high"] == {"rank": 2, "appsSeen": 1}
+        assert set(state["bands"]) == {"low", "normal", "high"}
+        assert "team-a" in state["tenants"]
+        preemption = state["preemption"]
+        assert preemption["journalDepth"] == 0
+        assert preemption["evictionsTotal"] == 0
+        assert preemption["recent"] == []
+        assert preemption["whatif"] == {
+            "attempts": 0, "validated": 0, "rejected": 0,
+        }
+    finally:
+        if http is not None:
+            http.stop()
+        h.close()
+
+    # no engine (the default Install): the endpoint still answers
+    bare = Harness()
+    http = None
+    try:
+        http = ExtenderHTTPServer(bare.server, port=0)
+        http.start()
+        assert get_state(http.port) == {"enabled": False}
+    finally:
+        if http is not None:
+            http.stop()
+        bare.close()
