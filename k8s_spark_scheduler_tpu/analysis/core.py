@@ -87,6 +87,7 @@ DEFAULT_ALLOWLIST: Dict[str, List[dict]] = {
         {"path": "kube/restclient.py", "why": "idle-connection reconnect tracks real socket age"},
         {"path": "kube/ratelimit.py", "why": "token-bucket refill meters real API-server wall time"},
         {"path": "utils/tpuprobe.py", "why": "subprocess probe timeout bounds real wall time"},
+        {"path": "ha/crashmatrix.py", "why": "matrix cells run live servers with wall-clock lease TTLs; waits must bound real time"},
         {"path": "tracing/", "why": "latency measurement wants real durations even in sims"},
     ],
     "DT001": [],

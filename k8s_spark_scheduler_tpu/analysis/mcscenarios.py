@@ -644,6 +644,136 @@ def _preemption_scenario() -> Scenario:
     )
 
 
+# ---------------------------------------------------------------------------
+# 8. Fencing: lease steal vs. renewal observation vs. in-flight write-back
+# ---------------------------------------------------------------------------
+
+
+def _fencing_scenario() -> Scenario:
+    """The split-brain triangle (ha/fencing.py): a rival CAS-steals the
+    lease at epoch 2 while the resident leader (epoch 1) has write-backs
+    in flight and its renewal loop is racing to observe the steal.  The
+    contract under every interleaving: a write whose read-through peek
+    already saw epoch 2 refuses deterministically; a commit may straddle
+    the steal only when the lease moved *between* its peek and its
+    commit (the irreducible in-flight window), and the fence's
+    stale-commit witness counts at most those straddlers — it never
+    invents one.  Once deposition is observed, every later check
+    refuses."""
+    from ..ha.fencing import FencedWriter, FenceState, StaleEpochError
+
+    @guarded_by("_lock", "epoch")
+    class LeaseView:
+        """The coordination lease as the read-through sees it."""
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.epoch = 1
+
+        def peek(self):
+            with self._lock:
+                racecheck.note_access(self, "epoch")
+                view = LeaseView.__new__(LeaseView)
+                view.epoch = self.epoch
+                return view
+
+        def steal(self, epoch: int):
+            with self._lock:
+                racecheck.note_access(self, "epoch")
+                self.epoch = epoch
+
+    class State:
+        def __init__(self):
+            self.lease = LeaseView()
+            self.fence = FenceState()
+            self.fence.grant(1)
+            self.writer = FencedWriter(self.fence, lease_reader=self.lease.peek)
+            self._lock = threading.Lock()
+            self.committed: List[int] = []
+            self.refused = 0
+            # commits whose peek→commit window straddled the steal
+            self.straddled = 0
+
+    def setup():
+        return State()
+
+    def threads(st: State):
+        def write(op: str):
+            try:
+                epoch = st.writer.check(op)
+            except StaleEpochError:
+                with st._lock:
+                    st.refused += 1
+                return
+            checkpoint("pre-commit")  # the in-flight window
+            st.writer.commit()
+            # deposition is monotone (no re-grant in this scenario), so
+            # "deposed now" is a sound upper bound for "deposed when
+            # note_commit ran" — every fence-counted straddler is
+            # counted here too, never the reverse
+            deposed = st.fence.deposed()
+            with st._lock:
+                st.committed.append(epoch)
+                if deposed:
+                    st.straddled += 1
+
+        def rival():
+            # the rival's CAS lands on the lease object first; the
+            # resident only learns of it via a peek or a renewal
+            st.lease.steal(2)
+
+        def renewer():
+            # the renewal round observing whatever the lease holds now
+            st.fence.observe(st.lease.peek().epoch)
+
+        return [
+            ("write-a", lambda: write("writeback.create")),
+            ("write-b", lambda: write("writeback.update")),
+            ("rival", rival),
+            ("renewer", renewer),
+        ]
+
+    def invariant(st: State):
+        with st._lock:
+            committed = list(st.committed)
+        for epoch in committed:
+            assert epoch == 1, f"write committed at unheld epoch {epoch}"
+
+    def final(st: State):
+        with st._lock:
+            decided = len(st.committed) + st.refused
+            straddled = st.straddled
+        # the witness only counts commits that really straddled the
+        # steal (asserted post-quiesce: mid-flight the bookkeeping and
+        # the fence counter are updated at different instants)
+        assert st.fence.stale_commits() <= straddled, (
+            f"fence counted {st.fence.stale_commits()} stale commits but "
+            f"only {straddled} straddled the steal"
+        )
+        assert decided == 2, f"a write was neither committed nor refused ({decided}/2)"
+        # the steal always lands; once anything has observed it, every
+        # subsequent check must refuse — probe it
+        assert st.fence.observe(st.lease.peek().epoch), "deposition not visible"
+        try:
+            st.writer.check("writeback.probe")
+        except StaleEpochError:
+            pass
+        else:
+            raise AssertionError("check passed after deposition was observed")
+
+    return Scenario(
+        name="fencing-steal-vs-writeback",
+        setup=setup,
+        threads=threads,
+        invariant=invariant,
+        final=final,
+        description="lease steal vs. renewal observation vs. in-flight "
+        "write-back: commits only at the held epoch, refusals are "
+        "deterministic once deposition is visible, and the stale-commit "
+        "witness never over-counts, on every interleaving",
+    )
+
+
 def corpus() -> List[Scenario]:
     return [
         _changefeed_scenario(),
@@ -653,4 +783,5 @@ def corpus() -> List[Scenario]:
         _engine_scenario(),
         _sampler_scenario(),
         _preemption_scenario(),
+        _fencing_scenario(),
     ]

@@ -58,6 +58,12 @@ class ResilienceConfig:
     lane_failure_threshold: int = 3
     lane_cooloff_seconds: float = 60.0
     lane_latency_budget_seconds: Optional[float] = 5.0
+    # journal compaction: rewrite the file to pending-only once dead
+    # records (acked / superseded puts + ack markers) exceed this
+    # fraction of the file, but never below the record floor — small
+    # journals aren't worth the rewrite churn
+    journal_compact_fraction: float = 0.5
+    journal_compact_min_records: int = 64
 
     @staticmethod
     def from_dict(d: dict) -> "ResilienceConfig":
@@ -71,6 +77,8 @@ class ResilienceConfig:
             lane_failure_threshold=d.get("lane-failure-threshold", 3),
             lane_cooloff_seconds=d.get("lane-cooloff-seconds", 60.0),
             lane_latency_budget_seconds=d.get("lane-latency-budget-seconds", 5.0),
+            journal_compact_fraction=d.get("journal-compact-fraction", 0.5),
+            journal_compact_min_records=d.get("journal-compact-min-records", 64),
         )
 
 
@@ -223,6 +231,45 @@ class PolicyConfig:
 
 
 @dataclass
+class HAConfig:
+    """HA failover fabric (ha/): lease-fenced multi-replica operation.
+
+    Disabled (the default) wires nothing — no elector, no fence gates,
+    single-replica behavior byte-identical to pre-HA builds.  Enabled,
+    the replica elects over a coordination lease, stamps every fenced
+    write with its epoch, and runs full state reconciliation on
+    takeover.
+    """
+
+    enabled: bool = False
+    lease_namespace: str = "default"
+    lease_name: str = "tpu-gang-scheduler"
+    # how stale a lease may go before a candidate may steal it; mirrors
+    # client-go's LeaseDuration default (resource.go:57-59)
+    lease_duration_seconds: float = 15.0
+    # background renewal cadence (prod); the sim and tests step the
+    # elector manually under the virtual clock
+    renew_interval_seconds: float = 5.0
+    # replica identity on the lease; "" = <hostname>-<pid> at wiring
+    identity: str = ""
+    # start the background renewal thread from start_background();
+    # the sim/tests disable this and drive fabric.step() themselves
+    background: bool = True
+
+    @staticmethod
+    def from_dict(d: dict) -> "HAConfig":
+        return HAConfig(
+            enabled=d.get("enabled", False),
+            lease_namespace=d.get("lease-namespace", "default"),
+            lease_name=d.get("lease-name", "tpu-gang-scheduler"),
+            lease_duration_seconds=d.get("lease-duration-seconds", 15.0),
+            renew_interval_seconds=d.get("renew-interval-seconds", 5.0),
+            identity=d.get("identity", ""),
+            background=d.get("background", True),
+        )
+
+
+@dataclass
 class ConversionWebhookConfig:
     """Where the apiserver reaches the CRD conversion webhook (the
     reference wires this from the witchcraft server's service identity,
@@ -275,6 +322,9 @@ class Install:
     # scheduling policy: priority bands, ordering, backfill, preemption,
     # DRF (policy/) — disabled = byte-identical FIFO decisions
     policy: PolicyConfig = field(default_factory=PolicyConfig)
+    # HA failover fabric: leader election + fencing + takeover
+    # reconciliation (ha/) — disabled = single-replica, nothing wired
+    ha: HAConfig = field(default_factory=HAConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -350,4 +400,5 @@ class Install:
             capacity=CapacityConfig.from_dict(d.get("capacity", {})),
             contention=ContentionConfig.from_dict(d.get("contention", {})),
             policy=PolicyConfig.from_dict(d.get("policy", {})),
+            ha=HAConfig.from_dict(d.get("ha", {})),
         )

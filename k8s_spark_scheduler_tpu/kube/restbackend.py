@@ -115,6 +115,28 @@ _RESOURCES: Dict[str, _Resource] = {
 }
 
 
+def _register_lease_resource() -> None:
+    # deferred: ha/lease.py imports kube/errors, keep this module's
+    # import graph acyclic by registering the Lease mapping lazily on
+    # first module load of either side
+    from ..ha.lease import Lease, lease_from_wire, lease_to_wire
+
+    _RESOURCES.setdefault(
+        Lease.KIND,
+        _Resource(
+            Lease.KIND,
+            "/apis/coordination.k8s.io/v1",
+            "leases",
+            True,
+            lease_to_wire,
+            lease_from_wire,
+        ),
+    )
+
+
+_register_lease_resource()
+
+
 def _k8s_wire(obj_dict: dict) -> dict:
     """Adapt the embedded wire form to real k8s wire shape — the ONE
     place float timestamps become RFC3339 (metadata timestamps and pod
@@ -424,12 +446,24 @@ class RestAPIServer:
         self.client.request("POST", CRD_BASE, body=self._crd_to_wire(name, spec))
 
     def update_crd(self, name: str, spec: dict) -> None:
-        current = self.client.request("GET", f"{CRD_BASE}/{name}")
-        wire = self._crd_to_wire(name, spec)
-        wire["metadata"]["resourceVersion"] = (current.get("metadata") or {}).get(
-            "resourceVersion", ""
-        )
-        self.client.request("PUT", f"{CRD_BASE}/{name}", body=wire)
+        # two replicas ensuring the CRD at boot race on this PUT; resolve
+        # 409s through the shared conflict-retry discipline
+        from .conflict import run_with_conflict_retry
+
+        state = {"rv": ""}
+
+        def refresh() -> bool:
+            current = self.client.request("GET", f"{CRD_BASE}/{name}")
+            state["rv"] = (current.get("metadata") or {}).get("resourceVersion", "")
+            return True
+
+        def attempt():
+            wire = self._crd_to_wire(name, spec)
+            wire["metadata"]["resourceVersion"] = state["rv"]
+            return self.client.request("PUT", f"{CRD_BASE}/{name}", body=wire)
+
+        refresh()
+        run_with_conflict_retry(attempt, refresh, kind="CustomResourceDefinition")
 
     def get_crd(self, name: str) -> Optional[dict]:
         try:

@@ -194,6 +194,43 @@ METRICS_REGISTRY_SERIES = (
     "foundry.spark.scheduler.tpu.metrics.registry.series"
 )
 
+# HA failover fabric (ha/): lease-fenced multi-replica operation
+# 1 while this replica holds the lease, 0 as follower
+HA_LEADER_STATE = "foundry.spark.scheduler.tpu.ha.leader.state"
+# the fencing epoch this replica holds (0 = never elected)
+HA_EPOCH = "foundry.spark.scheduler.tpu.ha.epoch"
+# leadership transitions, tagged to=leader|follower
+HA_TRANSITIONS = "foundry.spark.scheduler.tpu.ha.transitions.count"
+# fenced writes refused with StaleEpochError, tagged op=
+HA_FENCE_REFUSALS = "foundry.spark.scheduler.tpu.ha.fence.refused.count"
+# writes that committed while a newer epoch was observed — ALWAYS 0
+# (the I-H3 invariant witness; any nonzero value is a split-brain bug)
+HA_FENCE_STALE_COMMITS = (
+    "foundry.spark.scheduler.tpu.ha.fence.stale.commit.count"
+)
+# takeover reconciliation wall time (seconds)
+HA_RECONCILE_TIME = "foundry.spark.scheduler.tpu.ha.reconcile.time"
+# repairs applied by the takeover reconciler, tagged class=
+HA_RECONCILE_REPAIRS = (
+    "foundry.spark.scheduler.tpu.ha.reconcile.repairs.count"
+)
+
+# kube write-conflict discipline (kube/conflict.py): 409s resolved by
+# the unified get-refresh-resourceVersion-retry helper, tagged kind=
+KUBE_CONFLICT_RETRIES = (
+    "foundry.spark.scheduler.tpu.kube.conflict.retry.count"
+)
+
+# journal hardening (resilience/journal.py)
+# background compactions triggered by the acked-fraction threshold
+RESILIENCE_JOURNAL_COMPACTIONS = (
+    "foundry.spark.scheduler.resilience.journal.compaction.count"
+)
+# torn tails truncated at recovery (bad CRC / partial final records)
+RESILIENCE_JOURNAL_TORN_TAIL = (
+    "foundry.spark.scheduler.resilience.journal.torn.tail.count"
+)
+
 # policy engine (policy/): priority ordering, backfill, gang-aware
 # preemption, DRF fair share
 # committed preemptions (one per validated victim plan)

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 from .. import timesource
 from ..analysis import racecheck
 from ..analysis.guarded import guarded_by
+from ..ha import crashpoint
 from ..kube.errors import NotFoundError
 from ..resilience.journal import IntentJournal
 from ..types.objects import Pod
@@ -63,6 +64,15 @@ class PreemptionCoordinator:
         self._recent: deque = deque(maxlen=max(int(recent_limit), 1))
         self._evicted_total = 0
         self._victims_total = 0
+        # HA fencing gate (ha/fencing.FencedWriter), installed by server
+        # wiring: a deposed leader may not journal, execute, or ack
+        # evictions
+        self.fence_gate = None
+
+    def install_fence(self, gate) -> None:
+        self.fence_gate = gate
+        self._journal.fence_gate = gate
+        self._journal.epoch_source = gate.fence.epoch
 
     # -- commit ---------------------------------------------------------
 
@@ -71,6 +81,11 @@ class PreemptionCoordinator:
         evicted.  Intents for ALL victims are journaled before the
         first delete, so a crash at any point leaves a replayable
         record of the full plan — never a half-planned preemption."""
+        gate = self.fence_gate
+        if gate is not None:
+            # refuse the whole plan up front: a deposed leader must not
+            # even journal evict intents (the successor plans its own)
+            gate.check("preempt.commit")
         reason = (
             f"preempted by {plan.preemptor_app} "
             f"(band {plan.preemptor_band}, {plan.lane} what-if)"
@@ -89,10 +104,14 @@ class PreemptionCoordinator:
                     "tenant": v.tenant,
                 },
             )
+        crashpoint.maybe_crash(crashpoint.PREEMPT_POST_JOURNAL)
         evicted = []
         for v in plan.victims:
             self._execute(v.namespace, v.app_id, v.pods)
+            crashpoint.maybe_crash(crashpoint.PREEMPT_PRE_ACK)
             self._journal.ack("delete", v.namespace, v.app_id)
+            if gate is not None:
+                gate.commit()
             evicted.append(v.app_id)
             self._note_eviction(
                 ns=v.namespace,
@@ -115,6 +134,9 @@ class PreemptionCoordinator:
                 self._api.delete(Pod.KIND, ns, pod)
             except NotFoundError:
                 pass
+        # the half-evicted-gang window: pods gone, reservation still
+        # present — exactly what takeover reconciliation must finish
+        crashpoint.maybe_crash(crashpoint.PREEMPT_MID_EXECUTE)
         try:
             self._rr_cache.delete(ns, app_id)
         except NotFoundError:

@@ -14,7 +14,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -181,6 +181,11 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         self._last_request = 0.0
         # diagnostics: which lane served the last executor reschedule
         self.last_reschedule_path: Optional[str] = None
+        # HA fabric hook (server/wiring.py): the fencing-epoch reader,
+        # so every decision trace carries the epoch it was served under
+        # — post-mortems can attribute a decision to a leadership term.
+        # None (the default / single-replica) costs one attribute check.
+        self.epoch_source: Optional[Callable[[], int]] = None
 
     # -- entry point ---------------------------------------------------------
 
@@ -200,6 +205,8 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                     "predicate",
                     {"pod": args.pod.name, "namespace": args.pod.namespace},
                 ):
+                    if self.epoch_source is not None:
+                        tracing.add_tag("epoch", self.epoch_source())
                     # the request may have queued behind slow decisions
                     # for its whole deadline; answer fail-fast rather
                     # than spend the lock on a caller that already hung

@@ -208,12 +208,29 @@ class UnschedulablePodMarker:
         current = driver.conditions.get(POD_EXCEEDS_CLUSTER_CAPACITY)
         if current is not None and current.status == status:
             return
-        try:
-            fresh = self._api.get(Pod.KIND, driver.namespace, driver.name)
+        from ..kube.conflict import run_with_conflict_retry
+
+        state = {"fresh": None}
+
+        def refresh() -> bool:
+            state["fresh"] = self._api.get(Pod.KIND, driver.namespace, driver.name)
+            return True
+
+        def attempt():
+            fresh = state["fresh"]
             fresh.conditions[POD_EXCEEDS_CLUSTER_CAPACITY] = PodCondition(
-                type=POD_EXCEEDS_CLUSTER_CAPACITY, status=status, transition_time=timesource.now()
+                type=POD_EXCEEDS_CLUSTER_CAPACITY,
+                status=status,
+                transition_time=timesource.now(),
             )
-            self._api.update(fresh)
+            return self._api.update(fresh)
+
+        try:
+            # the kubelet and other controllers write pod status too, so
+            # 409s here are routine — resolve them through the shared
+            # conflict-retry discipline instead of swallowing the write
+            refresh()
+            run_with_conflict_retry(attempt, refresh, kind=Pod.KIND)
         except Exception:
             # per-pod failure (e.g. pod deleted concurrently) must not
             # abort the scan of the remaining drivers

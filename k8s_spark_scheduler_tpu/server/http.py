@@ -24,6 +24,8 @@
   (contention/criticalpath.py)
 - ``GET /policy/state`` — policy-engine state: priority bands, tenant
   dominant shares, recent evictions with reasons (policy/engine.py)
+- ``GET /status/ha`` — HA fabric state: leadership, fencing epoch,
+  lease holder/history, last takeover-reconciliation report (ha/)
 """
 
 from __future__ import annotations
@@ -165,6 +167,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # would put jit latency (and compiler-thread CPU
                 # contention) on the first Filter requests
                 and self.scheduler.warmup_complete()
+                # HA standby: a replica that does not hold the lease
+                # must not receive Filter traffic — its fenced write
+                # paths would refuse every decision's write-back anyway
+                and (
+                    getattr(self.scheduler, "ha", None) is None
+                    or self.scheduler.ha.is_leader()
+                )
             )
             kit = getattr(self.scheduler, "resilience", None)
             if kit is None:
@@ -219,6 +228,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_debug_criticalpath(query)
         elif path == "/policy/state" and self.scheduler is not None:
             self._handle_policy_state()
+        elif path == "/status/ha" and self.scheduler is not None:
+            fabric = getattr(self.scheduler, "ha", None)
+            if fabric is None:
+                self._send_json(200, {"enabled": False})
+                return
+            out = {"enabled": True}
+            out.update(fabric.status())
+            self._send_json(200, out)
         else:
             self._send_json(404, {"error": "not found"})
 

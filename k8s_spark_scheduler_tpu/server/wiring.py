@@ -71,6 +71,7 @@ class Server:
     contention: object = None  # LockTimekeeper (contention/locktime.py)
     criticalpath: object = None  # CriticalPathAnalyzer (contention/criticalpath.py)
     policy: object = None  # PolicyEngine (policy/engine.py)
+    ha: object = None  # HAFabric (ha/__init__.py)
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
@@ -81,6 +82,8 @@ class Server:
             self.reporters.start()
         if self.capacity is not None:
             self.capacity.start()
+        if self.ha is not None and self.install.ha.background:
+            self.ha.start()
         self._warm_solver_async()
 
     def warmup_complete(self) -> bool:
@@ -302,6 +305,14 @@ class Server:
             self.reporters.stop()
         if self.capacity is not None:
             self.capacity.stop()
+        if self.ha is not None:
+            self.ha.stop()
+            try:
+                # graceful handoff: expire our own lease so the standby
+                # takes over in one step instead of waiting out the TTL
+                self.ha.elector.step_down()
+            except Exception:
+                pass
         self.unschedulable_marker.stop()
         self.resource_reservation_cache.stop()
         self.demand_cache.stop()
@@ -400,6 +411,7 @@ def init_server_with_clients(
         rate_bucket=rate_bucket,
         breaker=resilience_kit.breaker,
         journal=resilience_kit.journal,
+        registry=metrics,
     )
     # failover: intents journaled by a previous instance (durable
     # journal-path) replay through the idempotent write path before any
@@ -414,6 +426,7 @@ def init_server_with_clients(
         api,
         install.async_client.max_retry_count,
         rate_bucket=rate_bucket,
+        registry=metrics,
     )
     demand_manager = DemandManager(
         demand_cache, binpacker, install.instance_group_label, event_log
@@ -588,6 +601,51 @@ def init_server_with_clients(
         policy=policy_engine,
     )
     server.reporters = ReporterSet(server)
+
+    # HA failover fabric (ha/): lease election + fencing + takeover
+    # reconciliation.  Built AFTER the boot-time journal recovery above
+    # on purpose: a cold replica's own replay must not be fenced (the
+    # gates are installed here, so everything before this line runs
+    # unfenced; everything after is epoch-checked).
+    if install.ha.enabled:
+        import os as _os
+        import socket as _socket
+
+        from ..ha import FencedWriter, FenceState, HAFabric
+        from ..ha.lease import LeaderElector
+        from ..ha.reconcile import Reconciler
+
+        identity = install.ha.identity or (
+            f"{_socket.gethostname()}-{_os.getpid()}"
+        )
+        fence = FenceState(metrics=metrics)
+        elector = LeaderElector(
+            api,
+            identity,
+            fence,
+            namespace=install.ha.lease_namespace,
+            name=install.ha.lease_name,
+            duration_seconds=install.ha.lease_duration_seconds,
+        )
+        # read-through gate: every fenced write re-reads the lease, so a
+        # deposed leader's first post-pause write refuses deterministically
+        gate = FencedWriter(fence, lease_reader=elector.peek, metrics=metrics)
+        # decision traces carry the epoch they were served under (one
+        # lock-free-ish counter read; never a lease fetch on the Filter
+        # path)
+        extender.epoch_source = fence.epoch
+        rr_cache.install_fence(gate)
+        demand_cache.install_fence(gate)
+        if policy_engine is not None and policy_engine.coordinator is not None:
+            policy_engine.coordinator.install_fence(gate)
+        server.ha = HAFabric(
+            elector,
+            fence,
+            reconciler=Reconciler(server, metrics=metrics),
+            metrics=metrics,
+            renew_interval_seconds=install.ha.renew_interval_seconds,
+            writer=gate,
+        )
 
     from ..scheduler import invariants
 

@@ -40,6 +40,21 @@ the audit widens to the policy invariants:
 and the FIFO F1 check becomes band-aware: within a band the queue is
 still FIFO, across bands priority order replaces arrival order.
 
+When the server carries an HA fabric (``Install.ha.enabled``) the
+audit widens again:
+
+- **I-H1** — at most one fenced writer per epoch: the lease history's
+  epochs are strictly increasing with one holder each, the live fence
+  never holds an epoch above the lease's (a self-granted token), and a
+  replica claiming leadership is the lease's recorded holder;
+- **I-H2** — no acked intent lost across takeover: journaled intents
+  never carry an epoch the fabric has not observed (a future-stamped
+  record would replay against the wrong leadership term; the
+  exactly-once replay itself is J1/J2 plus the crash matrix);
+- **I-H3** — no write committed with a stale epoch: the fence's
+  stale-commit witness counter is zero (by construction; nonzero means
+  a fenced write landed after a newer epoch was observed).
+
 Violations accumulate in ``violations`` (the run fails its acceptance
 bar when non-empty) and are counted into the PR 1 metrics registry
 under ``sim.audit.violations``.
@@ -158,6 +173,7 @@ class Auditor:
         self._check_demand_hygiene(label)
         self._check_lost_intents(label)
         self._check_policy_state(label)
+        self._check_ha(label)
         self._metrics.gauge("sim.audit.events", float(self.events_audited))
 
     def _check_demand_hygiene(self, label: str) -> None:
@@ -240,6 +256,53 @@ class Auditor:
                     f"I-P1[{label}]: pod {pod.name} of evicted app {app} is "
                     f"still bound to {pod.node_name} (partial-gang eviction)"
                 )
+
+    def _check_ha(self, label: str) -> None:
+        """I-H1..I-H3 against the HA fabric (see module docstring)."""
+        fabric = getattr(self._server, "ha", None)
+        if fabric is None:
+            return
+        lease = fabric.elector.peek()
+        if lease is not None:
+            epochs = [h[0] for h in lease.history]
+            if any(b <= a for a, b in zip(epochs, epochs[1:])):
+                self._violate(
+                    f"I-H1[{label}]: lease history epochs not strictly "
+                    f"increasing: {epochs}"
+                )
+            if epochs and lease.epoch != epochs[-1]:
+                self._violate(
+                    f"I-H1[{label}]: lease epoch {lease.epoch} != last "
+                    f"history epoch {epochs[-1]}"
+                )
+            if fabric.fence.epoch() > lease.epoch:
+                self._violate(
+                    f"I-H1[{label}]: fence holds epoch {fabric.fence.epoch()} "
+                    f"above the lease's {lease.epoch} (self-granted token)"
+                )
+            if fabric.is_leader() and lease.holder != fabric.elector.identity:
+                self._violate(
+                    f"I-H1[{label}]: replica {fabric.elector.identity!r} "
+                    f"claims leadership but the lease is held by "
+                    f"{lease.holder!r}"
+                )
+        kit = getattr(self._server, "resilience", None)
+        if kit is not None:
+            highest = fabric.fence.highest_observed()
+            for rec in kit.journal.pending():
+                epoch = rec.get("epoch")
+                if epoch is not None and epoch > highest:
+                    self._violate(
+                        f"I-H2[{label}]: journaled intent {rec['ns']}/"
+                        f"{rec['name']} stamped epoch {epoch} above any "
+                        f"observed epoch ({highest})"
+                    )
+        stale = fabric.fence.stale_commits()
+        if stale:
+            self._violate(
+                f"I-H3[{label}]: {stale} write(s) committed with a stale "
+                f"epoch"
+            )
 
     def _violate(self, message: str) -> None:
         self.violations.append(message)

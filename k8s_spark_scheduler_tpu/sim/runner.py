@@ -146,18 +146,30 @@ class Simulation:
         # chaos tests assert zero reports after the run
         racecheck.enable_if_env()
         extra_install = None
-        if sc.policy:
-            # thread the scenario's policy block into the REAL wiring:
-            # the harness builds the same Install it would by default,
-            # plus the policy engine (server/wiring.py)
-            from ..config import FifoConfig, Install, PolicyConfig
+        if sc.policy or sc.ha:
+            # thread the scenario's policy/ha blocks into the REAL
+            # wiring: the harness builds the same Install it would by
+            # default, plus the policy engine / HA fabric
+            # (server/wiring.py)
+            from ..config import FifoConfig, HAConfig, Install, PolicyConfig
 
-            self._policy_cfg = PolicyConfig.from_dict(sc.policy)
+            kwargs = {}
+            if sc.policy:
+                self._policy_cfg = PolicyConfig.from_dict(sc.policy)
+                kwargs["policy"] = self._policy_cfg
+            if sc.ha:
+                ha_cfg = HAConfig.from_dict(sc.ha)
+                # presence of the block is the opt-in, and the sim owns
+                # the election cadence: a wall-clock renewal thread
+                # would race the virtual event stream
+                ha_cfg.enabled = True
+                ha_cfg.background = False
+                kwargs["ha"] = ha_cfg
             extra_install = Install(
                 fifo=sc.fifo,
                 fifo_config=FifoConfig(),
                 binpack_algo=sc.binpack_algo,
-                policy=self._policy_cfg,
+                **kwargs,
             )
         self.harness = Harness(
             binpack_algo=sc.binpack_algo,
@@ -200,6 +212,11 @@ class Simulation:
                 deferred=True,  # determinism: fulfill only at virtual pumps
             )
         self.auditor = Auditor(self.harness.server)
+        # first election at t0: prod wiring elects on its renewal thread
+        # before traffic arrives; the sim's single replica must likewise
+        # hold the lease (epoch 1) before the first write-back, or every
+        # fenced write would refuse as never-elected
+        self._step_ha()
         tracker = getattr(self.harness.server, "provenance", None)
         if tracker is not None and self.bundle_dir:
             tracker.recorder.out_dir = self.bundle_dir
@@ -279,6 +296,9 @@ class Simulation:
         h.create_pod(driver)
 
     def _on_tick(self) -> None:
+        # lease renewal rides the tick cadence (the sim's stand-in for
+        # the prod renewal thread, on the virtual clock)
+        self._step_ha()
         fulfilled = self._pump_autoscaler()
         decisions = self._round("tick")
         # empty ticks (no decisions, no scale-up) are audited but not
@@ -343,6 +363,10 @@ class Simulation:
             self._fault_kernel(fault)
         elif fault.kind == "priority_storm":
             self._fault_priority_storm(fault)
+        elif fault.kind == "leader_crash":
+            self._fault_leader_crash(fault)
+        elif fault.kind == "lease_partition":
+            self._fault_lease_partition(fault)
         self._process(label, self._round(label))
 
     def _fault_node_kill(self, fault: FaultSpec) -> None:
@@ -521,6 +545,92 @@ class Simulation:
                 band=fault.band,
             )
             self._submit_app(spec)
+
+    # -- HA faults (ha/) ------------------------------------------------------
+
+    def _step_ha(self) -> None:
+        """One election/renewal round on the virtual clock (no-op when
+        the scenario carries no ``ha`` block)."""
+        fabric = getattr(self.harness.server, "ha", None)
+        if fabric is not None:
+            fabric.step()
+
+    def _fault_leader_crash(self, fault: FaultSpec) -> None:
+        """A rival replica CAS-steals the lease at epoch+1: the resident
+        fabric observes its deposition on the next step and every fenced
+        write refuses (intents divert to the journal, unacked).  The
+        rival's lease runs for ``duration``; at the clearing event it
+        has expired, the resident re-acquires at epoch+2 — running full
+        takeover reconciliation — and the diverted intents replay."""
+        from ..ha.lease import HISTORY_LIMIT
+
+        fabric = getattr(self.harness.server, "ha", None)
+        if fabric is None:
+            return
+        lease = fabric.elector.peek()
+        if lease is None:
+            return
+        now = self.clock.now()
+        rival = lease.deepcopy()
+        rival.holder = "chaos-rival"
+        rival.epoch = lease.epoch + 1
+        rival.acquired_at = now
+        rival.renewed_at = now
+        rival.duration_seconds = fault.duration
+        rival.history.append([rival.epoch, rival.holder, now])
+        del rival.history[:-HISTORY_LIMIT]
+        self.harness.api.update(rival)
+        # deposition is observed here, not at the next tick: the crash
+        # instant and the refusal window start at the same virtual time
+        self._step_ha()
+        self.clock.schedule(
+            now + fault.duration + 1.0,
+            "fault-clear:leader_crash",
+            self._on_leader_crash_clear,
+        )
+
+    def _on_leader_crash_clear(self) -> None:
+        # the rival's lease has expired: this step re-acquires at
+        # epoch+2, which runs takeover reconciliation (journal replay +
+        # CRD/pod diff) via the fabric's on_elected hook — then the
+        # write-back drain replays whatever the fenced window diverted
+        self._step_ha()
+        self._recover_writeback()
+        label = "fault-clear:leader_crash"
+        self._process(label, self._round(label))
+
+    def _fault_lease_partition(self, fault: FaultSpec) -> None:
+        """The replica loses the coordination API for ``duration``:
+        every Lease write fails, so renewals lapse and ``is_leader()``
+        self-demotes on TTL (readiness drops) before any rival is even
+        observed.  Fenced writes still read-through the (unchanged)
+        lease and keep landing at the held epoch — fencing, not the TTL,
+        is the split-brain guard.  Heals at the window's end."""
+        from ..kube.errors import APIError
+
+        if getattr(self.harness.server, "ha", None) is None:
+            return
+
+        def inject(op, kind, ns, name):
+            if kind == "Lease":
+                return APIError(f"injected lease partition ({op} {ns}/{name})")
+            return None
+
+        self.harness.api.set_write_fault(inject)
+        self.clock.schedule(
+            self.clock.now() + fault.duration,
+            "fault-clear:lease_partition",
+            self._on_lease_partition_clear,
+        )
+
+    def _on_lease_partition_clear(self) -> None:
+        self.harness.api.set_write_fault(None)
+        # renewal works again: re-assert leadership at the same epoch
+        # (no rival ran, so no takeover) and drain any diverted intents
+        self._step_ha()
+        self._recover_writeback()
+        label = "fault-clear:lease_partition"
+        self._process(label, self._round(label))
 
     def _kill_app(self, app_id: str) -> None:
         app = self._apps.get(app_id)
@@ -969,6 +1079,9 @@ class Simulation:
         policy = self._policy_summary()
         if policy is not None:
             summary["policy"] = policy
+        ha = self._ha_summary()
+        if ha is not None:
+            summary["ha"] = ha
         sampler = getattr(self.harness.server, "capacity", None) if self.harness else None
         timeline = (
             [s.to_dict() for s in sampler.timeline()] if sampler is not None else []
@@ -980,6 +1093,19 @@ class Simulation:
             violations=list(self.auditor.violations) if self.auditor else [],
             capacity_timeline=timeline,
         )
+
+    def _ha_summary(self) -> Optional[Dict]:
+        """Failover scorecard: the ``/status/ha`` payload at quiesce
+        (terminal epoch, fence refusal/stale-commit counters, full lease
+        succession history).  Summary-only, like the policy scorecard."""
+        fabric = (
+            getattr(self.harness.server, "ha", None)
+            if self.harness is not None
+            else None
+        )
+        if fabric is None:
+            return None
+        return fabric.status()
 
     def _policy_summary(self) -> Optional[Dict]:
         """Eviction scorecard: who got evicted and why, per-band driver

@@ -57,12 +57,25 @@ Fault catalog (all deterministic under the scenario seed):
 - ``priority_storm``: submit ``count`` fresh applications in the
   fault's ``band`` (default ``high``) at the fault instant — on a
   saturated cluster this exercises the policy engine's queue-jumping
-  and gang-atomic preemption path (policy/).
+  and gang-atomic preemption path (policy/);
+- ``leader_crash``: a rival replica steals the leadership lease at
+  epoch+1 — the resident fabric observes its deposition, every fenced
+  write path starts refusing (diverting intents to the journal), and
+  when the rival's lease expires at the window's end the resident
+  re-acquires at epoch+2 and runs full takeover reconciliation (ha/);
+- ``lease_partition``: for ``duration`` virtual seconds every Lease
+  write fails (the leader is partitioned from the coordination API) —
+  renewals lapse, ``is_leader()`` self-demotes on TTL, and the fabric
+  re-elects once the partition heals.
 
 A scenario may also carry a ``policy`` dict (the ``Install.policy``
 kebab-case keys from ``config.PolicyConfig.from_dict``); when present
 the simulator wires the full policy engine into the harness and the
-auditor arms the I-P1..I-P4 policy invariants.
+auditor arms the I-P1..I-P4 policy invariants.  An ``ha`` dict (the
+``Install.ha`` kebab-case keys from ``config.HAConfig.from_dict``)
+wires the HA fabric — lease election + fencing + takeover
+reconciliation — stepped deterministically on the virtual clock
+(``background`` is forced off), and arms the I-H1..I-H3 audits.
 """
 
 from __future__ import annotations
@@ -81,6 +94,8 @@ FAULT_KINDS = {
     "apiserver_latency",
     "kernel_fault",
     "priority_storm",
+    "leader_crash",
+    "lease_partition",
 }
 
 
@@ -141,6 +156,10 @@ class Scenario:
     # Install.policy overrides (kebab-case, PolicyConfig.from_dict);
     # empty = policy engine disabled, byte-identical FIFO
     policy: Dict = field(default_factory=dict)
+    # Install.ha overrides (kebab-case, HAConfig.from_dict); empty =
+    # no fabric.  background is forced off — the sim steps elections
+    # on the virtual clock
+    ha: Dict = field(default_factory=dict)
 
     @staticmethod
     def from_dict(d: Dict) -> "Scenario":
@@ -148,7 +167,7 @@ class Scenario:
         unknown = set(d) - {
             "name", "seed", "duration", "retry_interval", "binpack_algo",
             "fifo", "cluster", "workload", "autoscaler", "faults",
-            "unschedulable_scan_interval", "policy",
+            "unschedulable_scan_interval", "policy", "ha",
         }
         if unknown:
             raise ValueError(f"unknown scenario keys: {sorted(unknown)}")

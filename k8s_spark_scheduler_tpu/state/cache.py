@@ -17,6 +17,9 @@ from typing import List, Optional
 
 logger = logging.getLogger(__name__)
 
+from ..ha import crashpoint
+from ..ha.fencing import StaleEpochError
+from ..kube import conflict as kconflict
 from ..kube import errors as kerrors
 from ..kube.apiserver import APIServer
 from ..kube.informer import Informer
@@ -137,6 +140,7 @@ class AsyncClient:
         journal=None,
         kind: str = "",
         to_wire=None,
+        registry=None,
     ):
         self._client = client
         self._queue = queue
@@ -147,6 +151,14 @@ class AsyncClient:
         self._journal = journal
         self._kind = kind
         self._to_wire = to_wire
+        # full metrics registry (conflict-retry counter); the `metrics`
+        # param above is the per-request outcome marker, kept separate
+        # for reference parity
+        self._registry = registry
+        # HA fencing gate (ha/fencing.FencedWriter), installed by server
+        # wiring when the fabric is enabled: every API mutation is
+        # refused with StaleEpochError once this replica is deposed
+        self.fence_gate = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -182,6 +194,16 @@ class AsyncClient:
                     self._do_update(r)
                 elif r.type == DELETE:
                     self._do_delete(r)
+            except StaleEpochError as fe:
+                # deposed leader: the write is refused, never dropped —
+                # divert the intent to the journal so the successor's
+                # takeover replay owns it.  Not a breaker signal (the
+                # server was never touched).
+                logger.warning(
+                    "fenced write refused: %s %s (%s)", r.type, r.key, fe
+                )
+                self._release_probe()
+                self._divert(r, "journaled_fenced")
             except Exception:
                 # worker must survive anything, but a failure reaching here
                 # is a programming error (client errors are handled in the
@@ -195,12 +217,29 @@ class AsyncClient:
 
     # -- request handlers (async.go:77-137) ---------------------------------
 
+    def _pre_commit(self, r: Request) -> None:
+        """HA fence + crash-injection gate before any API mutation.
+        Raises StaleEpochError (worker loop diverts the intent to the
+        journal) or SimulatedCrash (BaseException — the crash matrix's
+        kill -9).  Disabled cost: two attribute reads."""
+        gate = self.fence_gate
+        if gate is not None:
+            gate.check(f"writeback.{r.type}")
+        crashpoint.maybe_crash(crashpoint.WRITEBACK_PRE_COMMIT)
+
+    def _post_commit(self) -> None:
+        gate = self.fence_gate
+        if gate is not None:
+            gate.commit()
+        crashpoint.maybe_crash(crashpoint.WRITEBACK_POST_COMMIT)
+
     def _do_create(self, r: Request) -> None:
         obj = self._store.get(r.key)
         if obj is None:
             self._release_probe()  # deleted while queued: no write happened
             return
         self._mark(r, "request")
+        self._pre_commit(r)
         try:
             result = self._client.create(obj)
         except kerrors.AlreadyExistsError:
@@ -227,6 +266,7 @@ class AsyncClient:
         # fold the result's RV in atomically, never resurrecting a key
         # deleted (e.g. by owner GC) while the create was in flight
         self._store.fold_resource_version(result)
+        self._post_commit()
         self._on_write_ok(r)
 
     def _do_update(self, r: Request) -> None:
@@ -235,8 +275,25 @@ class AsyncClient:
             self._release_probe()  # deleted while queued: no write happened
             return
         self._mark(r, "request")
+        self._pre_commit(r)
+
+        def attempt():
+            current = self._store.get(r.key)
+            if current is None:
+                return None  # deleted locally mid-retry: intent is moot
+            return self._client.update(current)
+
+        def refresh() -> bool:
+            # refresh RV from the server and rebase (async.go:111-120);
+            # a conflict means the server is alive — never a breaker
+            # signal.  False (key folded away locally) aborts the loop.
+            new_obj = self._client.get(r.key[0], r.key[1])
+            return self._store.fold_resource_version(new_obj)
+
         try:
-            result = self._client.update(obj)
+            result = kconflict.run_with_conflict_retry(
+                attempt, refresh, kind=self._kind, metrics=self._registry
+            )
         except kerrors.NotFoundError:
             if (
                 self._journal is not None
@@ -262,27 +319,21 @@ class AsyncClient:
                 self._mark(r, "retry")
                 self._queue.try_add_if_absent(r.with_incremented_retry_count())
             return
-        except kerrors.ConflictError:
-            # refresh RV from the server and retry inline (async.go:111-120);
-            # stop if the object vanished locally meanwhile.  A conflict
-            # means the server is alive — never a breaker signal.
-            try:
-                new_obj = self._client.get(r.key[0], r.key[1])
-            except Exception as get_err:
-                self._on_write_failure(r, get_err)
-                return
-            if not self._store.fold_resource_version(new_obj):
-                return
-            self._do_update(update_request(new_obj))
-            return
         except Exception as err:
+            # includes a ConflictError re-raised after the retry budget:
+            # route through the normal failure taxonomy (journal/retry)
             self._on_write_failure(r, err)
             return
+        if result is None:
+            self._release_probe()  # vanished locally: no write landed
+            return
         self._store.fold_resource_version(result)
+        self._post_commit()
         self._on_write_ok(r)
 
     def _do_delete(self, r: Request) -> None:
         self._mark(r, "request")
+        self._pre_commit(r)
         try:
             self._client.delete(r.key[0], r.key[1])
         except kerrors.NotFoundError:
@@ -291,6 +342,7 @@ class AsyncClient:
         except Exception as err:
             self._on_write_failure(r, err)
             return
+        self._post_commit()
         self._on_write_ok(r)
 
     # -- resilience hooks ----------------------------------------------------
@@ -345,7 +397,14 @@ class AsyncClient:
 
     def _ack_journal(self, r: Request) -> None:
         if self._journal is not None:
-            self._journal.ack(r.type, r.key[0], r.key[1])
+            try:
+                self._journal.ack(r.type, r.key[0], r.key[1])
+            except StaleEpochError:
+                # deposed between the write landing and the ack: leave
+                # the intent pending — the successor's replay is
+                # idempotent, losing the ack is safe; losing the intent
+                # would not be
+                logger.warning("fenced journal ack refused for %s", r.key)
 
     def replay_journal(self) -> int:
         """Re-enqueue every pending journaled intent through the normal

@@ -40,6 +40,7 @@ class ResourceReservationCache:
         rate_bucket=None,
         breaker=None,
         journal=None,
+        registry=None,
     ):
         self._queue = ShardedUniqueQueue(RESERVATION_WRITER_SHARDS)
         self._store = ObjectStore()
@@ -65,7 +66,17 @@ class ResourceReservationCache:
             journal=journal,
             kind=ResourceReservation.KIND,
             to_wire=serde.rr_to_dict_v1beta2,
+            registry=registry,
         )
+
+    def install_fence(self, gate) -> None:
+        """HA wiring: fence every reservation write-back (and journal
+        ack) behind the given :class:`~..ha.fencing.FencedWriter`, and
+        stamp journal records with the holder's epoch."""
+        self._async.fence_gate = gate
+        if self._journal is not None:
+            self._journal.fence_gate = gate
+            self._journal.epoch_source = gate.fence.epoch
 
     def add_change_observer(self, fn) -> None:
         """fn(old, new) on every semantic content change of the LOCAL
@@ -167,7 +178,12 @@ class DemandCache:
     """internal/cache/demands.go:40-117."""
 
     def __init__(
-        self, api: APIServer, informer: Informer, max_retry_count: int = 5, rate_bucket=None
+        self,
+        api: APIServer,
+        informer: Informer,
+        max_retry_count: int = 5,
+        rate_bucket=None,
+        registry=None,
     ):
         self._queue = ShardedUniqueQueue(DEMAND_WRITER_SHARDS)
         self._store = ObjectStore()
@@ -179,7 +195,17 @@ class DemandCache:
             from ..kube.ratelimit import RateLimitedClient
 
             client = RateLimitedClient(client, rate_bucket)
-        self._async = AsyncClient(client, self._queue, self._store, max_retry_count)
+        self._async = AsyncClient(
+            client,
+            self._queue,
+            self._store,
+            max_retry_count,
+            kind=Demand.KIND,
+            registry=registry,
+        )
+
+    def install_fence(self, gate) -> None:
+        self._async.fence_gate = gate
 
     def run(self) -> None:
         self._async.run()
@@ -287,14 +313,25 @@ class SafeDemandCache:
         api: APIServer,
         max_retry_count: int = 5,
         rate_bucket=None,
+        registry=None,
     ):
         self._lazy = lazy_informer
         self._api = api
         self._max_retry_count = max_retry_count
         self._rate_bucket = rate_bucket
+        self._registry = registry
+        self._fence_gate = None
         self._delegate: Optional[DemandCache] = None
         self._lock = threading.Lock()
         lazy_informer.on_ready(self._construct)
+
+    def install_fence(self, gate) -> None:
+        """HA wiring; applied immediately when the delegate exists, or
+        at lazy construction otherwise."""
+        with self._lock:
+            self._fence_gate = gate
+            if self._delegate is not None:
+                self._delegate.install_fence(gate)
 
     def _construct(self) -> None:
         with self._lock:
@@ -304,7 +341,10 @@ class SafeDemandCache:
                     self._lazy.informer(),
                     self._max_retry_count,
                     rate_bucket=self._rate_bucket,
+                    registry=self._registry,
                 )
+                if self._fence_gate is not None:
+                    cache.install_fence(self._fence_gate)
                 cache.run()
                 self._delegate = cache
 

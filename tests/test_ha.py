@@ -1,0 +1,283 @@
+"""HA fabric tests: lease CAS election, fencing epochs, split-brain.
+
+The unit half exercises the elector and fence directly against the
+embedded API server on a fake clock; the integration half drives two
+full server replicas through the crash-matrix harness's graceful
+handoff cell (the planned-failover analog of the kill -9 matrix in
+test_ha_crashpoints.py).
+"""
+
+import pytest
+
+from k8s_spark_scheduler_tpu import timesource
+from k8s_spark_scheduler_tpu.ha.crashmatrix import CrashMatrix
+from k8s_spark_scheduler_tpu.ha.fencing import (
+    FencedWriter,
+    FenceState,
+    StaleEpochError,
+)
+from k8s_spark_scheduler_tpu.ha.lease import (
+    HISTORY_LIMIT,
+    LeaderElector,
+    Lease,
+    lease_from_wire,
+    lease_to_wire,
+)
+from k8s_spark_scheduler_tpu.kube.apiserver import APIServer
+from k8s_spark_scheduler_tpu.kube.errors import APIError
+from k8s_spark_scheduler_tpu.types.objects import ObjectMeta
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    c = FakeClock()
+    timesource.set_source(c)
+    yield c
+    timesource.reset()
+
+
+def _elector(api, identity, duration=30.0, **kwargs):
+    return LeaderElector(
+        api, identity, FenceState(), duration_seconds=duration, **kwargs
+    )
+
+
+# -- elector -----------------------------------------------------------------
+
+
+def test_first_step_creates_lease_at_epoch_one(clock):
+    api = APIServer()
+    a = _elector(api, "replica-a")
+    assert a.step() is True
+    assert a.is_leader()
+    assert a.fence.epoch() == 1
+    lease = a.peek()
+    assert lease.holder == "replica-a"
+    assert lease.epoch == 1
+    assert lease.history == [[1, "replica-a", clock.t]]
+
+
+def test_second_replica_stays_follower_under_live_lease(clock):
+    api = APIServer()
+    a = _elector(api, "replica-a")
+    b = _elector(api, "replica-b")
+    assert a.step()
+    assert b.step() is False
+    assert not b.is_leader()
+    # the follower observed the leader's epoch but was never granted one
+    assert b.fence.highest_observed() == 1
+    assert b.fence.epoch() == 0
+
+
+def test_expired_lease_acquired_at_next_epoch_and_deposes(clock):
+    api = APIServer()
+    deposed_at = []
+    a = _elector(api, "replica-a", on_deposed=deposed_at.append)
+    b = _elector(api, "replica-b")
+    assert a.step()
+    clock.advance(31.0)  # past the 30s TTL: a's lease is stealable
+    assert b.step() is True
+    assert b.fence.epoch() == 2
+    assert b.peek().history == [
+        [1, "replica-a", clock.t - 31.0],
+        [2, "replica-b", clock.t],
+    ]
+    # a's next round observes the steal: deposed, callback fired
+    assert a.step() is False
+    assert not a.is_leader()
+    assert a.fence.deposed()
+    assert deposed_at == [2]
+
+
+def test_step_down_hands_off_without_ttl_wait(clock):
+    api = APIServer()
+    a = _elector(api, "replica-a")
+    b = _elector(api, "replica-b")
+    assert a.step()
+    assert b.step() is False
+    a.step_down()
+    assert not a.is_leader()
+    # no clock advance: the standby takes over immediately
+    assert b.step() is True
+    assert b.fence.epoch() == 2
+
+
+def test_partitioned_leader_self_demotes_on_ttl(clock):
+    """Renewals fail (coordination-API partition) → the leader keeps
+    serving until its own TTL lapses, then stops claiming leadership
+    even though it never observed a rival."""
+    api = APIServer()
+    a = _elector(api, "replica-a")
+    assert a.step()
+
+    def fail_lease(op, kind, ns, name):
+        if kind == Lease.KIND:
+            return APIError(f"partition ({op} {ns}/{name})")
+        return None
+
+    api.set_write_fault(fail_lease)
+    clock.advance(10.0)
+    assert a.step() is True  # renew failed but the TTL has not lapsed
+    assert a.is_leader()
+    clock.advance(21.0)  # now - last_renewal > duration
+    assert not a.is_leader()
+
+
+def test_reelection_after_deposition_clears_the_fence(clock):
+    api = APIServer()
+    a = _elector(api, "replica-a")
+    b = _elector(api, "replica-b")
+    assert a.step()
+    clock.advance(31.0)
+    assert b.step()
+    assert a.step() is False and a.fence.deposed()
+    clock.advance(31.0)  # b's lease lapses too
+    assert a.step() is True
+    assert a.fence.epoch() == 3
+    assert not a.fence.deposed()
+    assert a.is_leader()
+
+
+def test_lease_history_is_bounded(clock):
+    api = APIServer()
+    a = _elector(api, "replica-a")
+    b = _elector(api, "replica-b")
+    assert a.step()
+    for _ in range(HISTORY_LIMIT + 8):
+        clock.advance(31.0)
+        winner = b if a.peek().holder == "replica-a" else a
+        assert winner.step()
+    lease = a.peek()
+    assert len(lease.history) == HISTORY_LIMIT
+    epochs = [h[0] for h in lease.history]
+    assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs)
+    assert lease.history[-1][0] == lease.epoch
+
+
+def test_lease_wire_round_trip(clock):
+    lease = Lease(
+        meta=ObjectMeta(name="sched", namespace="kube-system", resource_version=7),
+        holder="replica-a",
+        epoch=3,
+        acquired_at=5.0,
+        renewed_at=9.0,
+        duration_seconds=15.0,
+        history=[[1, "x", 1.0], [2, "y", 3.0], [3, "replica-a", 5.0]],
+    )
+    back = lease_from_wire(lease_to_wire(lease))
+    assert back.holder == lease.holder
+    assert back.epoch == lease.epoch
+    assert back.duration_seconds == lease.duration_seconds
+    assert back.history == lease.history
+    assert back.meta.resource_version == 7
+
+
+# -- fencing -----------------------------------------------------------------
+
+
+def test_never_elected_writer_refuses():
+    writer = FencedWriter(FenceState())
+    with pytest.raises(StaleEpochError) as e:
+        writer.check("writeback.create")
+    assert e.value.held_epoch == 0
+
+
+def test_granted_writer_passes_and_accounts_commits():
+    fence = FenceState()
+    fence.grant(1)
+    writer = FencedWriter(fence)
+    assert writer.check("writeback.create") == 1
+    writer.commit()
+    st = fence.state()
+    assert st["commits"] == 1 and st["staleCommits"] == 0 and st["refusals"] == {}
+
+
+def test_deposed_writer_refuses_and_counts_per_op():
+    fence = FenceState()
+    fence.grant(1)
+    assert fence.observe(2) is True
+    writer = FencedWriter(fence)
+    for _ in range(3):
+        with pytest.raises(StaleEpochError):
+            writer.check("writeback.update")
+    with pytest.raises(StaleEpochError):
+        writer.check("preempt.commit")
+    assert fence.state()["refusals"] == {"writeback.update": 3, "preempt.commit": 1}
+
+
+def test_read_through_observes_lease_movement_on_the_write_path():
+    """The lease moved but no renewal tick has run: the very first
+    fenced write must still refuse (read-through, not poll-based)."""
+    fence = FenceState()
+    fence.grant(1)
+    moved = Lease(epoch=2)
+    writer = FencedWriter(fence, lease_reader=lambda: moved)
+    with pytest.raises(StaleEpochError) as e:
+        writer.check("writeback.create")
+    assert e.value.observed_epoch == 2
+    assert fence.highest_observed() == 2
+    assert fence.deposed()
+
+
+def test_stale_commit_witness_counts_check_commit_straddles():
+    """A write that passed check() before deposition but commits after
+    is the one hole fencing cannot close at the gate — the I-H3 witness
+    must count it."""
+    fence = FenceState()
+    fence.grant(1)
+    writer = FencedWriter(fence)
+    assert writer.check("writeback.create") == 1
+    fence.observe(2)  # deposed between check and commit
+    writer.commit()
+    assert fence.stale_commits() == 1
+
+
+# -- split-brain -------------------------------------------------------------
+
+
+def test_split_brain_deposed_writer_fenced_100_percent(clock):
+    """After a rival steals the lease, EVERY write through the old
+    leader's gate refuses — zero stale writes can land."""
+    api = APIServer()
+    a = _elector(api, "replica-a")
+    b = _elector(api, "replica-b")
+    assert a.step()
+    writer_a = FencedWriter(a.fence, lease_reader=a.peek)
+    assert writer_a.check("writeback.create") == 1
+    writer_a.commit()
+
+    clock.advance(31.0)
+    assert b.step()  # rival steals at epoch 2; a has NOT stepped since
+
+    refused = 0
+    for op in ("writeback.create", "writeback.update", "writeback.delete",
+               "demand.create", "demand.delete", "preempt.commit",
+               "journal.ack") * 3:
+        with pytest.raises(StaleEpochError):
+            writer_a.check(op)
+        refused += 1
+    assert refused == 21
+    assert a.fence.refusals() == refused
+    assert a.fence.stale_commits() == 0
+
+
+def test_two_replica_graceful_handoff_end_to_end():
+    """Two full server replicas on one API server: leader steps down,
+    standby takes over at epoch 2, the deposed replica's write paths
+    refuse 100%, the new leader schedules and drains cleanly."""
+    report = CrashMatrix(nodes=2).run_handoff()
+    assert report["ok"], report["violations"]
+    assert report["handoffEpoch"] == 2
+    assert report["deposedRefusals"] == 5
+    assert report["staleCommits"] == {"replica-a": 0, "replica-b": 0}

@@ -248,10 +248,14 @@ def test_journal_durable_roundtrip_and_compaction(tmp_path):
     assert reloaded.depth() == 1
     assert reloaded.pending_keys() == {("default", "b")}
     assert reloaded.pending()[0]["obj"] == {"spec": 2}
-    # compaction rewrote the file to pending-only
+    # compaction rewrote the file to pending-only, every line CRC-framed
+    from k8s_spark_scheduler_tpu.resilience.journal import FRAME_MAGIC, _unframe
+
     with open(path) as f:
-        lines = [json.loads(line) for line in f if line.strip()]
-    assert len(lines) == 1 and lines[0]["name"] == "b"
+        raw = [line.rstrip("\n") for line in f if line.strip()]
+    assert all(line.startswith(FRAME_MAGIC + " ") for line in raw)
+    lines = [_unframe(line) for line in raw]
+    assert len(lines) == 1 and lines[0] is not None and lines[0]["name"] == "b"
     reloaded.close()
 
 
