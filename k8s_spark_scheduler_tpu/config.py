@@ -155,6 +155,43 @@ class CapacityConfig:
 
 
 @dataclass
+class LifecycleConfig:
+    """Gang lifecycle ledger + SLO engine (lifecycle/): per-application
+    state machine, burn-rate objectives, and the ``/slo`` +
+    ``/lifecycle`` scorecard endpoints.  Diagnostic only — no
+    scheduling decision consumes a ledger or SLO output.
+
+    Draining is change-triggered (EventLog emits and the state layer's
+    ChangeFeed wake the ledger thread, debounced) with
+    ``interval_seconds`` as the idle-heartbeat fallback.
+    ``window_scale`` multiplies every SLO alert window (1 h/5 m and
+    6 h/30 m) so short virtual sim timelines can compress the policy
+    without changing the algebra; ``objectives`` overrides per-objective
+    ``target``/``threshold`` (keys: time_to_admit, filter_latency,
+    eviction_waste, fairness_gap)."""
+
+    enabled: bool = True
+    ring_size: int = 2048
+    debounce_seconds: float = 0.05
+    interval_seconds: float = 5.0
+    window_scale: float = 1.0
+    sample_cap: int = 4096
+    objectives: Dict[str, Dict[str, float]] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: dict) -> "LifecycleConfig":
+        return LifecycleConfig(
+            enabled=d.get("enabled", True),
+            ring_size=d.get("ring-size", 2048),
+            debounce_seconds=d.get("debounce-seconds", 0.05),
+            interval_seconds=d.get("interval-seconds", 5.0),
+            window_scale=d.get("window-scale", 1.0),
+            sample_cap=d.get("sample-cap", 4096),
+            objectives=d.get("objectives", {}),
+        )
+
+
+@dataclass
 class ContentionConfig:
     """Contention observatory (contention/): lock wait/hold telemetry
     and per-request critical-path decomposition behind
@@ -325,6 +362,9 @@ class Install:
     # HA failover fabric: leader election + fencing + takeover
     # reconciliation (ha/) — disabled = single-replica, nothing wired
     ha: HAConfig = field(default_factory=HAConfig)
+    # gang lifecycle ledger + SLO burn-rate engine (lifecycle/) —
+    # diagnostic only, decisions unchanged
+    lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -401,4 +441,5 @@ class Install:
             contention=ContentionConfig.from_dict(d.get("contention", {})),
             policy=PolicyConfig.from_dict(d.get("policy", {})),
             ha=HAConfig.from_dict(d.get("ha", {})),
+            lifecycle=LifecycleConfig.from_dict(d.get("lifecycle", {})),
         )

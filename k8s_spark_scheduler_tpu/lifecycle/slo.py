@@ -1,0 +1,346 @@
+"""SLO objectives with multi-window multi-burn-rate evaluation.
+
+Four declarative objectives judge the scheduler end to end (the Borg
+operator-facing truths: wait time, latency, eviction waste, fairness):
+
+- ``time_to_admit``   — gang queue wait (submitted → bound) within
+  threshold for ``target`` of admissions.
+- ``filter_latency``  — scheduling-request root-span duration within
+  threshold for ``target`` of requests.
+- ``eviction_waste``  — scheduling-waste samples (WasteMetricsReporter
+  is the single source of truth) within threshold for ``target`` of
+  samples.
+- ``fairness_gap``    — per-drain DRF probe: dominant-share spread
+  across tenants within threshold for ``target`` of probes.
+
+Every objective is a good/bad event stream; burn rate over a window is
+``bad_fraction(window) / (1 - target)`` — Google-SRE multi-window
+multi-burn-rate alerting pages when burn ≥ 14.4 over BOTH the 1 h and
+5 m windows, tickets when burn ≥ 6 over both 6 h and 30 m.  Windows
+scale by ``window_scale`` so short virtual sim timelines can compress
+the policy without changing the algebra.
+
+Timestamps flow through ``timesource.now()``: virtual in the sim, so a
+scenario's burn rates (and the scorecard digest over them) are
+deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import timesource
+from ..analysis import racecheck
+from ..analysis.guarded import guarded_by
+
+# (state, long_window_s, short_window_s, burn_threshold) — evaluated in
+# order, first match wins (page dominates warn)
+DEFAULT_ALERT_POLICY: Tuple[Tuple[str, float, float, float], ...] = (
+    ("page", 3600.0, 300.0, 14.4),
+    ("warn", 21600.0, 1800.0, 6.0),
+)
+
+# objective name → (target, threshold, unit, description)
+DEFAULT_OBJECTIVES: Tuple[Tuple[str, float, float, str, str], ...] = (
+    (
+        "time_to_admit",
+        0.99,
+        300.0,
+        "seconds",
+        "gang queue wait submitted->bound within threshold",
+    ),
+    (
+        "filter_latency",
+        0.99,
+        0.1,
+        "seconds",
+        "scheduling-request root span duration within threshold",
+    ),
+    (
+        "eviction_waste",
+        0.95,
+        60.0,
+        "seconds",
+        "scheduling-waste sample duration within threshold",
+    ),
+    (
+        "fairness_gap",
+        0.95,
+        0.25,
+        "dominant-share fraction",
+        "DRF dominant-share spread across tenants within threshold",
+    ),
+)
+
+_STATE_RANK = {"ok": 0, "warn": 1, "page": 2}
+
+
+class Objective:
+    """One good/bad event stream plus its target.  Not thread-safe on
+    its own — the engine's lock serializes all access."""
+
+    __slots__ = (
+        "name",
+        "target",
+        "threshold",
+        "unit",
+        "description",
+        "samples",
+        "good_total",
+        "bad_total",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        target: float,
+        threshold: float,
+        unit: str = "",
+        description: str = "",
+        sample_cap: int = 4096,
+    ):
+        self.name = name
+        self.target = float(target)
+        self.threshold = float(threshold)
+        self.unit = unit
+        self.description = description
+        # (timestamp, good) — bounded; windows far exceeding the cap
+        # degrade to the retained tail, never to unbounded memory
+        self.samples: deque = deque(maxlen=sample_cap)
+        self.good_total = 0
+        self.bad_total = 0
+
+    def observe(self, t: float, good: bool) -> None:
+        self.samples.append((t, bool(good)))
+        if good:
+            self.good_total += 1
+        else:
+            self.bad_total += 1
+
+    def bad_fraction(self, now: float, window: float) -> Optional[float]:
+        """Fraction of bad samples in [now - window, now], or None when
+        the window holds no samples (no data is not an alert)."""
+        lo = now - window
+        good = bad = 0
+        for t, ok in reversed(self.samples):
+            if t < lo:
+                break
+            if t > now:
+                continue
+            if ok:
+                good += 1
+            else:
+                bad += 1
+        total = good + bad
+        if total == 0:
+            return None
+        return bad / total
+
+    def burn_rate(self, now: float, window: float) -> Optional[float]:
+        frac = self.bad_fraction(now, window)
+        if frac is None:
+            return None
+        budget = 1.0 - self.target
+        if budget <= 0.0:
+            return float("inf") if frac > 0 else 0.0
+        return frac / budget
+
+
+@guarded_by("_lock", "_objectives", "_alert_tag", "_evaluations")
+class SloEngine:
+    """Objective registry + burn-rate evaluator + alert-tag source.
+
+    ``observe``/``waste_sample`` may be called from informer threads,
+    the waste reporter, or the ledger drain; ``evaluate`` runs at drain
+    time and precomputes ``alert_tag`` so the extender's decision-trace
+    tagging is one attribute read — never a burn-rate computation under
+    the predicate lock.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        window_scale: float = 1.0,
+        sample_cap: int = 4096,
+        overrides: Optional[Dict[str, Dict[str, float]]] = None,
+    ):
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self.window_scale = float(window_scale) if window_scale > 0 else 1.0
+        self._objectives: Dict[str, Objective] = {}
+        for name, target, threshold, unit, desc in DEFAULT_OBJECTIVES:
+            ov = (overrides or {}).get(name, {})
+            self._objectives[name] = Objective(
+                name,
+                float(ov.get("target", target)),
+                float(ov.get("threshold", threshold)),
+                unit,
+                desc,
+                sample_cap=sample_cap,
+            )
+        self._evaluations = 0
+        # precomputed at evaluate(): "" when every objective is ok,
+        # else "obj:state,..." — the extender reads this one attribute
+        self._alert_tag = ""
+
+    # -- ingest ---------------------------------------------------------------
+
+    def observe(
+        self,
+        objective: str,
+        value: float,
+        good: Optional[bool] = None,
+        t: Optional[float] = None,
+    ) -> None:
+        """Record one sample.  ``good`` defaults to value ≤ threshold."""
+        with self._lock:
+            obj = self._objectives.get(objective)
+            if obj is None:
+                return
+            racecheck.note_access(self, "_objectives")
+            if good is None:
+                good = value <= obj.threshold
+            obj.observe(timesource.now() if t is None else t, good)
+        if self._metrics is not None:
+            from ..metrics import names as mnames
+
+            self._metrics.counter(
+                mnames.SLO_EVENTS,
+                tags={
+                    mnames.TAG_OBJECTIVE: objective,
+                    mnames.TAG_OUTCOME: "good" if good else "bad",
+                },
+            )
+
+    def waste_sample(
+        self, waste_type: str, duration: float, t: Optional[float] = None
+    ) -> None:
+        """Sink for WasteMetricsReporter (the single source of truth
+        for eviction-waste): one waste phase measurement becomes one
+        eviction_waste sample."""
+        del waste_type  # classification lives in the waste metrics
+        self.observe("eviction_waste", float(duration), t=t)
+
+    # -- evaluation -----------------------------------------------------------
+
+    def _status_locked(self, now: float) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, obj in self._objectives.items():
+            windows: Dict[str, Any] = {}
+            state = "ok"
+            for st, long_w, short_w, burn in DEFAULT_ALERT_POLICY:
+                long_s = long_w * self.window_scale
+                short_s = short_w * self.window_scale
+                b_long = obj.burn_rate(now, long_s)
+                b_short = obj.burn_rate(now, short_s)
+                windows[st] = {
+                    "longWindowSeconds": long_s,
+                    "shortWindowSeconds": short_s,
+                    "burnThreshold": burn,
+                    "longBurnRate": _round(b_long),
+                    "shortBurnRate": _round(b_short),
+                }
+                if (
+                    state == "ok"
+                    and b_long is not None
+                    and b_short is not None
+                    and b_long >= burn
+                    and b_short >= burn
+                ):
+                    state = st
+            # budget remaining over the long ticket window: 1 - burn
+            budget_window = DEFAULT_ALERT_POLICY[-1][1] * self.window_scale
+            burn = obj.burn_rate(now, budget_window)
+            budget_remaining = None if burn is None else max(0.0, 1.0 - burn)
+            out[name] = {
+                "target": obj.target,
+                "threshold": obj.threshold,
+                "unit": obj.unit,
+                "description": obj.description,
+                "good": obj.good_total,
+                "bad": obj.bad_total,
+                "total": obj.good_total + obj.bad_total,
+                "state": state,
+                "budgetRemaining": _round(budget_remaining),
+                "windows": windows,
+            }
+        return out
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Recompute every objective's burn state, refresh gauges and
+        the precomputed alert tag, and return the status dict."""
+        now = timesource.now() if now is None else now
+        with self._lock:
+            racecheck.note_access(self, "_evaluations")
+            racecheck.note_access(self, "_alert_tag")
+            status = self._status_locked(now)
+            self._evaluations += 1
+            alerting = [
+                f"{name}:{s['state']}"
+                for name, s in status.items()
+                if s["state"] != "ok"
+            ]
+            alerting.sort(
+                key=lambda item: -_STATE_RANK.get(item.split(":")[1], 0)
+            )
+            self._alert_tag = ",".join(alerting)
+        if self._metrics is not None:
+            self._publish(status)
+        return status
+
+    def status(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Per-objective burn-rate status (no gauge side effects)."""
+        now = timesource.now() if now is None else now
+        with self._lock:
+            return self._status_locked(now)
+
+    @property
+    def alert_tag(self) -> str:
+        """Precomputed at evaluate(): O(1) read for decision tracing."""
+        with self._lock:
+            return self._alert_tag
+
+    @property
+    def evaluations(self) -> int:
+        with self._lock:
+            return self._evaluations
+
+    def objective_names(self) -> List[str]:
+        with self._lock:
+            return list(self._objectives)
+
+    def _publish(self, status: Dict[str, Any]) -> None:
+        from ..metrics import names as mnames
+
+        for name, s in status.items():
+            tags = {mnames.TAG_OBJECTIVE: name}
+            self._metrics.gauge(
+                mnames.SLO_STATE, float(_STATE_RANK[s["state"]]), tags
+            )
+            if s["budgetRemaining"] is not None:
+                self._metrics.gauge(
+                    mnames.SLO_BUDGET_REMAINING, s["budgetRemaining"], tags
+                )
+            for window_name, w in s["windows"].items():
+                for side in ("long", "short"):
+                    rate = w[f"{side}BurnRate"]
+                    if rate is None:
+                        continue
+                    self._metrics.gauge(
+                        mnames.SLO_BURN_RATE,
+                        rate,
+                        {
+                            mnames.TAG_OBJECTIVE: name,
+                            mnames.TAG_WINDOW: f"{window_name}-{side}",
+                        },
+                    )
+
+
+def _round(value: Optional[float], digits: int = 6) -> Optional[float]:
+    if value is None:
+        return None
+    # clamp the zero-budget sentinel: scorecards must stay valid JSON
+    value = min(float(value), 1e9)
+    return round(value, digits)

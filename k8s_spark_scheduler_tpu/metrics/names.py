@@ -246,6 +246,47 @@ POLICY_DRF_SHARE = "foundry.spark.scheduler.tpu.policy.drf.share"
 # blocked queue heads safely skipped by the conservative backfill probe
 POLICY_BACKFILL_SKIPS = "foundry.spark.scheduler.tpu.policy.backfill.skips"
 
+# gang lifecycle ledger (lifecycle/ledger.py)
+# phase transitions (counter, tagged phase=)
+LIFECYCLE_TRANSITIONS = (
+    "foundry.spark.scheduler.tpu.lifecycle.transitions.count"
+)
+# gangs currently in each phase (gauge, tagged phase=)
+LIFECYCLE_GANGS = "foundry.spark.scheduler.tpu.lifecycle.gangs"
+# gang queue wait submitted→bound (seconds; histogram)
+LIFECYCLE_QUEUE_WAIT = (
+    "foundry.spark.scheduler.tpu.lifecycle.queue.wait.time"
+)
+# per-request solver tenure attributed to a gang (seconds; histogram)
+LIFECYCLE_SOLVE_TENURE = (
+    "foundry.spark.scheduler.tpu.lifecycle.solve.tenure.time"
+)
+# gangs evicted, by coarse cause bucket (counter, tagged cause=)
+LIFECYCLE_EVICTIONS = (
+    "foundry.spark.scheduler.tpu.lifecycle.evictions.count"
+)
+
+# SLO engine (lifecycle/slo.py)
+# good/bad samples per objective (counter, tagged objective=, outcome=)
+SLO_EVENTS = "foundry.spark.scheduler.tpu.slo.events.count"
+# burn rate per objective and alert window (gauge, tagged objective=,
+# window=page-long|page-short|warn-long|warn-short)
+SLO_BURN_RATE = "foundry.spark.scheduler.tpu.slo.burn.rate"
+# error budget remaining over the long ticket window (gauge, 0..1)
+SLO_BUDGET_REMAINING = "foundry.spark.scheduler.tpu.slo.budget.remaining"
+# alert state per objective (gauge: 0 ok, 1 warn, 2 page)
+SLO_STATE = "foundry.spark.scheduler.tpu.slo.state"
+
+# sim runner decision instrumentation (sim/runner.py) — virtual-clock
+# scenario metrics, namespaced so the catalog contract covers them
+SIM_DECISION_LATENCY = "foundry.spark.scheduler.tpu.sim.decision.latency"
+SIM_QUEUE_DEPTH = "foundry.spark.scheduler.tpu.sim.queue.depth"
+# auditor coverage (sim/auditor.py): events audited / invariant hits
+SIM_AUDIT_EVENTS = "foundry.spark.scheduler.tpu.sim.audit.events"
+SIM_AUDIT_VIOLATIONS = (
+    "foundry.spark.scheduler.tpu.sim.audit.violations.count"
+)
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"
@@ -263,6 +304,9 @@ TAG_LOCK = "lock"
 TAG_PHASE = "phase"
 TAG_HOLDER = "holder"
 TAG_SEGMENT = "segment"
+TAG_OBJECTIVE = "objective"
+TAG_WINDOW = "window"
+TAG_CAUSE = "cause"
 
 TICK_INTERVAL_SECONDS = 30.0
 SLOW_LOG_THRESHOLD_SECONDS = 45.0

@@ -50,6 +50,11 @@ class WasteMetricsReporter:
         self._instance_group_label = instance_group_label
         self._lock = threading.Lock()
         self._info: Dict[Tuple[str, str], _PodSchedulingInfo] = {}
+        # SLO hook (server/wiring.py): ``slo_sink(waste_type, duration)``
+        # forwards every waste sample to the eviction_waste objective —
+        # this reporter is the single source of truth for waste, so the
+        # SLO engine never re-derives it from raw informer events
+        self.slo_sink = None
 
     # -- wiring (waste.go:88-120) -------------------------------------------
 
@@ -178,6 +183,11 @@ class WasteMetricsReporter:
             duration,
             {names.TAG_WASTE_TYPE: waste_type, names.TAG_INSTANCE_GROUP: instance_group},
         )
+        if self.slo_sink is not None:
+            try:
+                self.slo_sink(waste_type, duration)
+            except Exception:  # the sink must never break pod handling
+                logger.exception("slo waste sink failed")
         if duration > slow_threshold:
             logger.warning(
                 "scheduling waste above threshold: pod=%s/%s type=%s duration=%.1fs",

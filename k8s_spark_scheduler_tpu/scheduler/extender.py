@@ -186,6 +186,11 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
         # — post-mortems can attribute a decision to a leadership term.
         # None (the default / single-replica) costs one attribute check.
         self.epoch_source: Optional[Callable[[], int]] = None
+        # SLO engine hook (server/wiring.py): reads the precomputed
+        # alert-tag string (e.g. "eviction_waste:page") so decision
+        # traces made during an SLO burn carry that context.  The value
+        # is computed at ledger drain time, never on this path.
+        self.slo_alert_source: Optional[Callable[[], str]] = None
 
     # -- entry point ---------------------------------------------------------
 
@@ -207,6 +212,10 @@ class SparkSchedulerExtender:  # schedlint: disable=LK004 -- _predicate_lock ser
                 ):
                     if self.epoch_source is not None:
                         tracing.add_tag("epoch", self.epoch_source())
+                    if self.slo_alert_source is not None:
+                        alert = self.slo_alert_source()
+                        if alert:
+                            tracing.add_tag("sloAlert", alert)
                     # the request may have queued behind slow decisions
                     # for its whole deadline; answer fail-fast rather
                     # than spend the lock on a caller that already hung

@@ -26,6 +26,12 @@
   dominant shares, recent evictions with reasons (policy/engine.py)
 - ``GET /status/ha`` — HA fabric state: leadership, fencing epoch,
   lease holder/history, last takeover-reconciliation report (ha/)
+- ``GET /slo`` — the scorecard: per-objective multi-window burn-rate
+  status + lifecycle summary, same schema as the sim runner's
+  scorecard.json (lifecycle/scorecard.py)
+- ``GET /lifecycle`` / ``GET /lifecycle/<app>`` — gang lifecycle
+  ledger: per-application phase machine with queue-wait/solve-tenure
+  durations, eviction causes, and HA epoch continuity (lifecycle/)
 """
 
 from __future__ import annotations
@@ -228,6 +234,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_debug_criticalpath(query)
         elif path == "/policy/state" and self.scheduler is not None:
             self._handle_policy_state()
+        elif path == "/slo" and self.scheduler is not None:
+            self._handle_slo()
+        elif (
+            path == "/lifecycle" or path.startswith("/lifecycle/")
+        ) and self.scheduler is not None:
+            self._handle_lifecycle(unquote(path[len("/lifecycle"):]).lstrip("/"))
         elif path == "/status/ha" and self.scheduler is not None:
             fabric = getattr(self.scheduler, "ha", None)
             if fabric is None:
@@ -287,6 +299,53 @@ class _Handler(BaseHTTPRequestHandler):
                     "error": f"no recorded decision for pod {pod_name!r}",
                     "ringSize": tracker.stats()["ring"]["size"],
                 },
+            )
+            return
+        self._send_json(200, record)
+
+    def _handle_slo(self) -> None:
+        """The live scorecard: burn-rate status per objective plus the
+        lifecycle summary, in the exact schema the sim runner emits
+        (lifecycle/scorecard.py) so dashboards and the policy-
+        regression gate never fork on source."""
+        slo = getattr(self.scheduler, "slo", None)
+        ledger = getattr(self.scheduler, "lifecycle", None)
+        if slo is None:
+            self._send_json(404, {"error": "slo engine not enabled"})
+            return
+        if ledger is not None:
+            # freshen: pull any pending cursor work before reporting
+            # (same on-demand pattern as /state/capacity)
+            ledger.maybe_drain(trigger="http")
+        from ..lifecycle import build_scorecard
+
+        self._send_json(
+            200, build_scorecard(ledger, slo, meta={"source": "server"})
+        )
+
+    def _handle_lifecycle(self, app_id: str) -> None:
+        """``/lifecycle`` — ledger summary + per-gang brief list;
+        ``/lifecycle/<app>`` — one gang's full record (phase
+        timestamps, queue wait, solve tenure, eviction cause, epochs,
+        correlated trace ids)."""
+        ledger = getattr(self.scheduler, "lifecycle", None)
+        if ledger is None:
+            self._send_json(404, {"error": "lifecycle ledger not enabled"})
+            return
+        ledger.maybe_drain(trigger="http")
+        if not app_id:
+            self._send_json(
+                200,
+                {
+                    "summary": ledger.summary(),
+                    "gangs": ledger.records_brief(),
+                },
+            )
+            return
+        record = ledger.record(app_id)
+        if record is None:
+            self._send_json(
+                404, {"error": f"no lifecycle record for app {app_id!r}"}
             )
             return
         self._send_json(200, record)

@@ -72,6 +72,8 @@ class Server:
     criticalpath: object = None  # CriticalPathAnalyzer (contention/criticalpath.py)
     policy: object = None  # PolicyEngine (policy/engine.py)
     ha: object = None  # HAFabric (ha/__init__.py)
+    lifecycle: object = None  # LifecycleLedger (lifecycle/ledger.py)
+    slo: object = None  # SloEngine (lifecycle/slo.py)
 
     def start_background(self) -> None:
         """Start async writers + periodic loops (cmd/server.go:221-230)."""
@@ -82,6 +84,8 @@ class Server:
             self.reporters.start()
         if self.capacity is not None:
             self.capacity.start()
+        if self.lifecycle is not None:
+            self.lifecycle.start()
         if self.ha is not None and self.install.ha.background:
             self.ha.start()
         self._warm_solver_async()
@@ -305,6 +309,8 @@ class Server:
             self.reporters.stop()
         if self.capacity is not None:
             self.capacity.stop()
+        if self.lifecycle is not None:
+            self.lifecycle.stop()
         if self.ha is not None:
             self.ha.stop()
             try:
@@ -515,6 +521,38 @@ def init_server_with_clients(
         # (mirrors rr_cache.recover_from_journal above)
         policy_engine.recover()
 
+    # gang lifecycle ledger + SLO engine (lifecycle/): per-application
+    # state machine fed off informer threads and drain cursors — never
+    # under the predicate lock.  The waste reporter's slo_sink makes
+    # WasteMetricsReporter the single source of truth for the
+    # eviction_waste objective.
+    lifecycle_ledger = None
+    slo_engine = None
+    if install.lifecycle.enabled:
+        from ..lifecycle import LifecycleLedger, SloEngine
+
+        slo_engine = SloEngine(
+            metrics=metrics,
+            window_scale=install.lifecycle.window_scale,
+            sample_cap=install.lifecycle.sample_cap,
+            overrides=install.lifecycle.objectives,
+        )
+        waste_reporter.slo_sink = slo_engine.waste_sample
+        lifecycle_ledger = LifecycleLedger(
+            event_log=event_log,
+            tracer=tracer,
+            feed=tensor_snapshot.feed,
+            policy=policy_engine,
+            slo=slo_engine,
+            metrics=metrics,
+            ring_size=install.lifecycle.ring_size,
+            debounce_seconds=install.lifecycle.debounce_seconds,
+            interval_seconds=install.lifecycle.interval_seconds,
+        )
+        lifecycle_ledger.wire_informers(
+            pod_informer=pod_informer, rr_informer=rr_informer
+        )
+
     # extender (cmd/server.go:171-191)
     node_sorter = NodeSorter(
         install.driver_prioritized_node_label, install.executor_prioritized_node_label
@@ -550,6 +588,11 @@ def init_server_with_clients(
         # what-if victim validation rides the extender's warm
         # delta-solve sessions (ops/deltasolve.py latest_basis)
         policy_engine._delta_engine = extender.delta_engine
+    if slo_engine is not None:
+        # decision traces carry the active SLO alert states (one
+        # precomputed-attribute read; never a burn-rate computation on
+        # the Filter path — evaluate() runs at ledger drain time)
+        extender.slo_alert_source = lambda: slo_engine.alert_tag
     if provenance_tracker is not None and extender.delta_engine is not None:
         # warm≠cold parity guard: every Nth warm hit re-proves the
         # session verdicts against the stateless cold solver and fires
@@ -599,6 +642,8 @@ def init_server_with_clients(
         contention=contention_keeper,
         criticalpath=criticalpath_analyzer,
         policy=policy_engine,
+        lifecycle=lifecycle_ledger,
+        slo=slo_engine,
     )
     server.reporters = ReporterSet(server)
 
@@ -634,6 +679,10 @@ def init_server_with_clients(
         # lock-free-ish counter read; never a lease fetch on the Filter
         # path)
         extender.epoch_source = fence.epoch
+        if lifecycle_ledger is not None:
+            # lifecycle records stamp the epoch each transition was
+            # observed under (epoch continuity across failover)
+            lifecycle_ledger.epoch_source = fence.epoch
         rr_cache.install_fence(gate)
         demand_cache.install_fence(gate)
         if policy_engine is not None and policy_engine.coordinator is not None:

@@ -112,6 +112,11 @@ def main(argv=None) -> int:
             json.dump(
                 result.summary.get("contention"), f, indent=2, sort_keys=True
             )
+        # SLO scorecard (same schema as a live GET /slo): the input to
+        # the policy-regression gate (tools/policy_regression.py)
+        if result.summary.get("slo") is not None:
+            with open(os.path.join(args.out, "scorecard.json"), "w") as f:
+                json.dump(result.summary["slo"], f, indent=2, sort_keys=True)
 
     if not args.quiet:
         json.dump(result.summary, sys.stdout, indent=2, sort_keys=True)

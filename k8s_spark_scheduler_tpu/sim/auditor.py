@@ -57,7 +57,7 @@ audit widens again:
 
 Violations accumulate in ``violations`` (the run fails its acceptance
 bar when non-empty) and are counted into the PR 1 metrics registry
-under ``sim.audit.violations``.
+under the catalog name ``…tpu.sim.audit.violations.count``.
 """
 
 from __future__ import annotations
@@ -67,6 +67,7 @@ from typing import List
 
 from .. import timesource
 from ..demands.manager import pod_name_from_demand
+from ..metrics import names as mnames
 from ..scheduler import invariants
 from ..scheduler import labels as L
 from ..scheduler.extender import FAILURE_EARLIER_DRIVER
@@ -174,7 +175,7 @@ class Auditor:
         self._check_lost_intents(label)
         self._check_policy_state(label)
         self._check_ha(label)
-        self._metrics.gauge("sim.audit.events", float(self.events_audited))
+        self._metrics.gauge(mnames.SIM_AUDIT_EVENTS, float(self.events_audited))
 
     def _check_demand_hygiene(self, label: str) -> None:
         api = self._server.api
@@ -306,4 +307,4 @@ class Auditor:
 
     def _violate(self, message: str) -> None:
         self.violations.append(message)
-        self._metrics.counter("sim.audit.violations")
+        self._metrics.counter(mnames.SIM_AUDIT_VIOLATIONS)

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 
 from .. import timesource
 from ..analysis import racecheck
+from ..metrics import names as mnames
 from ..scheduler import labels as L
 from ..scheduler.failover import sync_resource_reservations_and_demands
 from ..testing.fake_autoscaler import FakeAutoscaler
@@ -101,6 +102,9 @@ class Simulation:
         self._storm_idx = 0
         self._evictions_reaped = 0
         self._band_outcomes: Dict[str, Dict[str, int]] = {}
+        # SLO scorecard snapshotted at end-of-run while the virtual
+        # clock is still installed (None when lifecycle is disabled)
+        self._scorecard: Optional[Dict] = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -124,6 +128,7 @@ class Simulation:
             # drain: one final round + audit so the log always ends on
             # quiesced, audited state
             self._process("end", self._round("end"))
+            self._snapshot_scorecard()
         finally:
             try:
                 if self.harness is not None:
@@ -188,6 +193,12 @@ class Simulation:
             # the summary's capacity columns and the timeline ring must
             # be a pure function of (scenario, seed)
             sampler.stop()
+        ledger = getattr(self.harness.server, "lifecycle", None)
+        if ledger is not None:
+            # same contract as the capacity sampler: the lifecycle
+            # ledger drains per sim event (seq-gated), never from its
+            # wall-clock background thread
+            ledger.stop()
         for i in range(sc.cluster.nodes):
             zone = sc.cluster.zones[i % len(sc.cluster.zones)]
             self.harness.new_node(
@@ -699,7 +710,7 @@ class Simulation:
             result = h.schedule(pod, node_names)
             dt = time.perf_counter() - t0
             self._latencies.append(dt)
-            h.server.metrics.histogram("sim.decision.latency", dt)
+            h.server.metrics.histogram(mnames.SIM_DECISION_LATENCY, dt)
             outcome = "success" if result.node_names else "failure"
             if not result.node_names and result.failed_nodes:
                 # all failed_nodes share one message; surface its outcome class
@@ -827,6 +838,7 @@ class Simulation:
         self._fire_invariant_trigger(label)
         self._schedule_scaler_pumps()
         self._sample_capacity(label)
+        self._drain_ledger(label)
         # one API listing per kind per event, shared by the depth gauge,
         # the log entry, and the fingerprint (APIServer.list deepcopies
         # every object — repeating it per consumer multiplied the sim's
@@ -839,7 +851,7 @@ class Simulation:
             if p.labels.get(L.SPARK_ROLE_LABEL) == L.DRIVER and not p.node_name
         )
         self._queue_depths.append(depth)
-        self.harness.server.metrics.gauge("sim.queue.depth", float(depth))
+        self.harness.server.metrics.gauge(mnames.SIM_QUEUE_DEPTH, float(depth))
         eff = self._packing_efficiency()
         if eff is not None:
             self._efficiencies.append(eff)
@@ -888,6 +900,39 @@ class Simulation:
         self._fire_invariant_trigger(label)
         self._schedule_scaler_pumps()
         self._sample_capacity(label)
+        self._drain_ledger(label)
+
+    def _drain_ledger(self, label: str) -> None:
+        """One lifecycle-ledger drain per state-changing event
+        (seq-gated inside the ledger, so idle events are O(1)) —
+        always post-quiesce and never under the predicate lock."""
+        ledger = getattr(self.harness.server, "lifecycle", None)
+        if ledger is None:
+            return
+        ledger.maybe_drain(trigger=f"sim:{label}")
+
+    def _snapshot_scorecard(self) -> None:
+        """Build the SLO scorecard at end-of-run, while the virtual
+        clock is still the process time source — ``_result`` runs after
+        ``timesource.reset()``, when burn-rate windows would evaluate
+        against wall-clock and every virtual sample would look ancient."""
+        ledger = getattr(self.harness.server, "lifecycle", None)
+        slo = getattr(self.harness.server, "slo", None)
+        if ledger is None or slo is None:
+            return
+        from ..lifecycle import build_scorecard
+
+        ledger.maybe_drain(trigger="sim:scorecard")
+        self._scorecard = build_scorecard(
+            ledger,
+            slo,
+            meta={
+                "source": "sim",
+                "scenario": self.scenario.name,
+                "seed": self.scenario.seed,
+            },
+            now=self.clock.now(),
+        )
 
     def _sample_capacity(self, label: str) -> None:
         """One capacity-observatory sample per state-changing event
@@ -1082,6 +1127,8 @@ class Simulation:
         ha = self._ha_summary()
         if ha is not None:
             summary["ha"] = ha
+        if self._scorecard is not None:
+            summary["slo"] = self._scorecard
         sampler = getattr(self.harness.server, "capacity", None) if self.harness else None
         timeline = (
             [s.to_dict() for s in sampler.timeline()] if sampler is not None else []

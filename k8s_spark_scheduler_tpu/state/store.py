@@ -15,7 +15,7 @@ import queue as _queue
 import threading
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..analysis import racecheck
 from ..analysis.guarded import guarded_by
@@ -208,11 +208,11 @@ class ChangeFeed:
         self._ring: Deque[Tuple[int, str, Optional[str]]] = deque(
             maxlen=capacity
         )
-        # optional wakeup Event set on every publish: the capacity
-        # sampler parks on it so sampling happens only on state change
-        # (Event.set is lock-free and idempotent — safe under the
-        # publisher's mirror lock)
-        self._wakeup = None
+        # optional wakeup Events set on every publish: the capacity
+        # sampler and lifecycle ledger park on them so work happens
+        # only on state change (Event.set is lock-free and idempotent
+        # — safe under the publisher's mirror lock)
+        self._wakeups: Tuple[Any, ...] = ()
         # happens-before channel key for the publish→wakeup edge; a
         # process-unique token, captured once, so a recycled object id
         # can never alias this feed's clock to another feed's
@@ -224,8 +224,10 @@ class ChangeFeed:
             return self._seq
 
     def attach_wakeup(self, event) -> None:
+        """Add a wakeup Event set on every publish.  Multi-listener:
+        appends rather than replaces (wiring-time call)."""
         with self._lock:
-            self._wakeup = event
+            self._wakeups = self._wakeups + (event,)
 
     def publish(self, kind: str, key: Optional[str] = None) -> int:
         with self._lock:
@@ -233,13 +235,14 @@ class ChangeFeed:
             self._seq += 1
             self._ring.append((self._seq, kind, key))
             seq = self._seq
-            wakeup = self._wakeup
-        if wakeup is not None:
+            wakeups = self._wakeups
+        if wakeups:
             # Event.set is synchronization the lock tracker can't see:
             # record the publish→wakeup happens-before edge explicitly
-            # (the sampler's wait side calls hb_observe on this channel)
+            # (each waiter calls hb_observe on this channel)
             racecheck.hb_publish(self.hb_channel())
-            wakeup.set()
+            for wakeup in wakeups:
+                wakeup.set()
         return seq
 
     def hb_channel(self) -> tuple:

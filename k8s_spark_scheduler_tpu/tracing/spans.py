@@ -159,7 +159,7 @@ class _NoopSpan:
 NOOP_SPAN = _NoopSpan()
 
 
-@guarded_by("_lock", "_ring")
+@guarded_by("_lock", "_ring", "_finished")
 class Tracer:
     """Span factory + bounded ring of completed traces.
 
@@ -179,6 +179,9 @@ class Tracer:
     ):
         self.enabled = enabled
         self._ring: deque = deque(maxlen=capacity)
+        # total completed traces ever — cursor for completed_since();
+        # the ring holds the most recent len(_ring) of them
+        self._finished = 0
         self._lock = threading.Lock()
         self._metrics = metrics
         self._record_span_metrics = record_span_metrics
@@ -221,6 +224,7 @@ class Tracer:
         }
         with self._lock:
             self._ring.append(trace)
+            self._finished += 1
         if self._metrics is not None and self._record_span_metrics:
             from ..metrics import names as mnames
 
@@ -243,6 +247,30 @@ class Tracer:
         """Register a trace-completion callback ``fn(root_span)``.
         Call at wiring time only — the list is read unlocked."""
         self._observers.append(fn)
+
+    @property
+    def completed_total(self) -> int:
+        """Total traces ever completed (monotonic drain cursor)."""
+        with self._lock:
+            return self._finished
+
+    def completed_since(self, cursor: int) -> Tuple[List[dict], int]:
+        """Traces completed after ``cursor`` (oldest first, truncated
+        to the ring's reach) and the new cursor value.  Pull-based
+        alternative to add_observer for consumers that must never run
+        inside a request — the lifecycle ledger drains here off-thread
+        because for direct predicate calls the root span closes (and
+        observers fire) while the predicate lock is still held."""
+        with self._lock:
+            total = self._finished
+            fresh = total - cursor
+            if fresh <= 0:
+                return [], total
+            n = min(fresh, len(self._ring))
+            if n == 0:
+                return [], total
+            out = list(self._ring)[-n:]
+        return out, total
 
     def traces(self, limit: Optional[int] = None) -> List[dict]:
         """Completed traces, newest first."""

@@ -1,0 +1,465 @@
+"""Gang lifecycle ledger + SLO burn-rate engine + scorecard gate.
+
+Covers the observability tentpole end to end: the EventLog's indexed
+ring at capacity rollover, the SLO engine's multi-window multi-burn-
+rate evaluation (Google-SRE alert policy), the ledger's state machine
+driven through the REAL wiring, the ``GET /slo`` / ``GET /lifecycle``
+surface, sim-vs-live scorecard schema identity, and the policy-
+regression gate's exit codes.
+"""
+
+import importlib.util
+import json
+import pathlib
+import urllib.request
+
+import pytest
+
+from k8s_spark_scheduler_tpu.events.events import EventLog
+from k8s_spark_scheduler_tpu.lifecycle import (
+    DEFAULT_OBJECTIVES,
+    SCHEMA_NAME,
+    SloEngine,
+    build_scorecard,
+    scorecard_diff,
+    scorecard_digest,
+)
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.tracing import Tracer
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+# -- event log: indexed ring at capacity rollover -----------------------------
+
+
+def test_eventlog_secondary_indexes_evict_in_lockstep_with_ring():
+    """ISSUE satellite: by_name/by_trace_id must never return an event
+    the capacity-bounded ring already dropped, and lookups are served
+    from the index buckets (O(matches)), not a ring scan."""
+    log = EventLog(capacity=4)
+    tracer = Tracer()
+    for i in range(6):
+        with tracer.span("root", trace_id=f"tr-{i % 2}"):
+            log.emit("evt.even" if i % 2 == 0 else "evt.odd", i=i)
+
+    assert log.seq == 6
+    retained = log.all()
+    assert [e.values["i"] for e in retained] == [2, 3, 4, 5]
+
+    # evicted events (i=0, i=1) are gone from BOTH indexes
+    assert [e.values["i"] for e in log.by_name("evt.even")] == [2, 4]
+    assert [e.values["i"] for e in log.by_name("evt.odd")] == [3, 5]
+    assert [e.values["i"] for e in log.by_trace_id("tr-0")] == [2, 4]
+    assert [e.values["i"] for e in log.by_trace_id("tr-1")] == [3, 5]
+
+    # a name whose every event rolled out leaves no empty bucket behind
+    log2 = EventLog(capacity=2)
+    log2.emit("gone.name")
+    log2.emit("other.a")
+    log2.emit("other.b")
+    assert log2.by_name("gone.name") == []
+    assert "gone.name" not in log2._by_name
+
+
+def test_eventlog_events_since_cursor_across_rollover():
+    log = EventLog(capacity=4)
+    for i in range(3):
+        log.emit("e", i=i)
+    fresh, cursor = log.events_since(0)
+    assert [e.values["i"] for e in fresh] == [0, 1, 2]
+    assert cursor == 3
+
+    # idempotent at the cursor
+    fresh, cursor = log.events_since(cursor)
+    assert fresh == [] and cursor == 3
+
+    # emit 5 more: the ring (capacity 4) can only reach the tail
+    for i in range(3, 8):
+        log.emit("e", i=i)
+    fresh, cursor = log.events_since(3)
+    assert [e.values["i"] for e in fresh] == [4, 5, 6, 7]
+    assert cursor == 8
+
+
+# -- SLO engine: multi-window multi-burn-rate ---------------------------------
+
+
+def test_slo_engine_reports_all_default_objectives():
+    engine = SloEngine()
+    status = engine.status(now=1000.0)
+    assert set(status) == {name for name, *_ in DEFAULT_OBJECTIVES}
+    assert len(status) >= 4
+    for body in status.values():
+        # no samples → no data → never an alert
+        assert body["state"] == "ok"
+        assert body["total"] == 0
+        assert set(body["windows"]) == {"page", "warn"}
+        for win in body["windows"].values():
+            assert win["longBurnRate"] is None
+            assert win["shortBurnRate"] is None
+
+
+def test_slo_fast_burn_pages_and_tags():
+    """All-bad traffic inside both page windows (1h AND 5m) burns at
+    1/(1-0.99) = 100x ≥ 14.4 → page, and the precomputed alert tag
+    carries it for decision-trace tagging."""
+    engine = SloEngine()
+    now = 100_000.0
+    for k in range(10):
+        engine.observe("time_to_admit", 900.0, t=now - 10.0 * k)
+    status = engine.evaluate(now=now)
+    body = status["time_to_admit"]
+    assert body["state"] == "page"
+    assert body["windows"]["page"]["longBurnRate"] == pytest.approx(100.0)
+    assert body["windows"]["page"]["shortBurnRate"] == pytest.approx(100.0)
+    assert "time_to_admit:page" in engine.alert_tag
+
+    # good traffic flushes the short window first: once the 5m window
+    # is clean the page alert must drop (multi-window = fast recovery)
+    later = now + 400.0
+    for k in range(20):
+        engine.observe("time_to_admit", 1.0, t=later - 10.0 * k)
+    status = engine.evaluate(now=later)
+    assert status["time_to_admit"]["state"] != "page"
+
+
+def test_slo_slow_burn_warns_without_paging():
+    """Bad samples older than the page short window (5m) but inside
+    the warn windows (6h AND 30m): the 5m window has no data, so the
+    page condition cannot fire, while the warn condition does."""
+    engine = SloEngine()
+    now = 1_000_000.0
+    for k in range(10):
+        engine.observe("filter_latency", 5.0, t=now - 600.0 - 30.0 * k)
+    status = engine.evaluate(now=now)
+    body = status["filter_latency"]
+    assert body["state"] == "warn"
+    assert body["windows"]["page"]["shortBurnRate"] is None
+    assert body["windows"]["warn"]["longBurnRate"] == pytest.approx(100.0)
+    assert engine.alert_tag == "filter_latency:warn"
+
+
+def test_slo_good_defaults_to_threshold_and_budget_tracks():
+    engine = SloEngine()
+    now = 50_000.0
+    engine.observe("filter_latency", 0.05, t=now)  # ≤ 0.1s → good
+    engine.observe("filter_latency", 5.0, t=now)  # > 0.1s → bad
+    body = engine.evaluate(now=now)["filter_latency"]
+    assert body["good"] == 1 and body["bad"] == 1 and body["total"] == 2
+    assert 0.0 <= body["budgetRemaining"] < 1.0
+
+
+# -- ledger: state machine through the real wiring ----------------------------
+
+
+def test_ledger_tracks_gang_lifecycle_end_to_end():
+    h = Harness()
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        pods = h.static_allocation_spark_pods("app-lc", 2)
+        h.assert_success(h.schedule(pods[0], ["n1", "n2"]))
+        for ex in pods[1:]:
+            h.assert_success(h.schedule(ex, ["n1", "n2"]))
+        h.wait_quiesced()
+
+        ledger = h.server.lifecycle
+        assert ledger is not None
+        ledger.drain(trigger="test")
+
+        rec = ledger.record("app-lc")
+        assert rec is not None
+        assert rec["phase"] == "running"
+        # every non-terminal phase got a first-arrival stamp, including
+        # "solving" (drained off the event log AFTER bound happened
+        # live — the pass-through stamp, not a backward transition)
+        for phase in ("submitted", "queued", "solving", "reserved", "bound", "running"):
+            assert phase in rec["phaseTimes"], (phase, rec["phaseTimes"])
+        assert rec["queueWaitSeconds"] is not None
+        assert rec["solveCount"] >= 1
+        assert rec["executorsBound"] == 2
+        assert rec["traceIds"], "scheduling traces not correlated"
+
+        # driver deletion after running → completed
+        h.delete_pod(pods[0])
+        h.wait_quiesced()
+        ledger.drain(trigger="test")
+        assert ledger.record("app-lc")["phase"] == "completed"
+
+        summary = ledger.summary()
+        assert summary["gangs"] == 1
+        assert summary["phases"].get("completed") == 1
+        assert summary["queueWait"]["count"] == 1
+        assert summary["lockViolations"] == 0
+    finally:
+        h.close()
+
+
+def test_ledger_drain_refused_under_predicate_lock():
+    """Acceptance (perf-guard structural check): the ledger runs ZERO
+    work under the predicate lock — an in-lock drain is refused and
+    counted, never served."""
+    from k8s_spark_scheduler_tpu import capacity as cap_pkg
+
+    h = Harness()
+    try:
+        h.new_node("n1")
+        ledger = h.server.lifecycle
+        ledger.stop()
+        cap_pkg.enter_predicate_lock()
+        try:
+            assert ledger.drain(trigger="in-lock") is None
+        finally:
+            cap_pkg.exit_predicate_lock()
+        assert ledger.lock_violations == 1
+        # off-lock drains work again immediately
+        assert ledger.drain(trigger="off-lock") is not None
+        assert ledger.lock_violations == 1
+    finally:
+        h.close()
+
+
+def test_eviction_waste_flows_reporter_to_slo_engine():
+    """ISSUE satellite: WasteMetricsReporter is the single source of
+    truth for eviction-waste — every waste phase it marks (including
+    the failed-scheduling-attempt split) lands as one eviction_waste
+    sample in the SLO engine via the slo_sink hook."""
+    from k8s_spark_scheduler_tpu.types.objects import DemandPhase
+
+    h = Harness()
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        slo = h.server.slo
+        assert slo is not None
+        before = slo.status()["eviction_waste"]["total"]
+
+        big = h.static_allocation_spark_pods("app-waste", 40)[0]
+        h.assert_failure(h.schedule(big, ["n1", "n2"]))
+        assert h.wait_for_api(lambda: len(h.api.list("Demand")) == 1)
+        demand = h.api.list("Demand")[0]
+        demand.status.phase = DemandPhase.FULFILLED
+        h.api.update(demand)
+        # a failed attempt AFTER fulfillment → the failure-outcome split
+        h.assert_failure(h.schedule(big, ["n1", "n2"]))
+        h.new_node("n3", cpu="64", memory="64Gi")
+        h.assert_success(h.schedule(big, ["n1", "n2", "n3"]))
+        h.wait_quiesced()
+
+        # before-demand-creation + after-demand-fulfilled +
+        # failure-<outcome> + since-last-failure = 4 samples
+        body = slo.status()["eviction_waste"]
+        assert body["total"] - before >= 4
+    finally:
+        h.close()
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_http_slo_and_lifecycle_endpoints():
+    from k8s_spark_scheduler_tpu.server.http import ExtenderHTTPServer
+
+    h = Harness()
+    http = None
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        pods = h.static_allocation_spark_pods("app-http", 1)
+        h.assert_success(h.schedule(pods[0], ["n1", "n2"]))
+        h.assert_success(h.schedule(pods[1], ["n1", "n2"]))
+        h.wait_quiesced()
+
+        http = ExtenderHTTPServer(h.server, port=0)
+        http.start()
+        port = http.port
+
+        # GET /slo: the scorecard with burn-rate status for ≥4 objectives
+        status, card = _get(port, "/slo")
+        assert status == 200
+        assert card["schema"]["name"] == SCHEMA_NAME
+        assert card["meta"]["source"] == "server"
+        assert len(card["objectives"]) >= 4
+        for body in card["objectives"].values():
+            assert body["state"] in ("ok", "warn", "page")
+            assert set(body["windows"]) == {"page", "warn"}
+        assert card["lifecycle"]["gangs"] >= 1
+        assert card["digest"] == scorecard_digest(card)
+
+        # GET /lifecycle: summary + per-gang briefs
+        status, listing = _get(port, "/lifecycle")
+        assert status == 200
+        assert listing["summary"]["gangs"] >= 1
+        assert any(g["app"] == "app-http" for g in listing["gangs"])
+
+        # GET /lifecycle/<app>: the full record
+        status, rec = _get(port, "/lifecycle/app-http")
+        assert status == 200
+        assert rec["app"] == "app-http"
+        assert rec["phase"] in ("bound", "running")
+
+        status, _ = _get(port, "/lifecycle/no-such-app")
+        assert status == 404
+    finally:
+        if http is not None:
+            http.stop()
+        h.close()
+
+
+# -- scorecard schema identity (sim vs live) ----------------------------------
+
+
+def _schema_tree(value, path=""):
+    """Recursive key structure, treating content-keyed dicts (phase
+    counts, eviction causes, per-objective map) as opaque leaves whose
+    VALUES still contribute structure."""
+    content_keyed = {
+        "lifecycle.phases",
+        "lifecycle.evictionsByCause",
+        "objectives",
+    }
+    if isinstance(value, dict):
+        if path == "":
+            # meta is free-form by contract (source/scenario/seed/asOf…)
+            # and digest-excluded — only its presence is schema
+            value = {k: (v if k != "meta" else {}) for k, v in value.items()}
+        if path in content_keyed:
+            sub = sorted({json.dumps(_schema_tree(v, path + ".*")) for v in value.values()})
+            return {"*": sub}
+        return {k: _schema_tree(v, f"{path}.{k}" if path else k) for k, v in sorted(value.items())}
+    return type(value).__name__ if not isinstance(value, (int, float, str, type(None))) else "leaf"
+
+
+def test_sim_and_live_scorecards_share_schema():
+    """Acceptance: the sim runner emits the SAME scorecard schema the
+    live server serves on GET /slo — dashboards and the regression gate
+    never fork on source."""
+    from k8s_spark_scheduler_tpu.sim import Scenario, Simulation
+
+    sc = Scenario.from_dict(
+        {
+            "name": "schema-probe",
+            "seed": 3,
+            "duration": 120,
+            "cluster": {"nodes": 4},
+            "workload": {"arrival": {"rate_per_min": 2.0}},
+        }
+    )
+    sim_card = Simulation(sc).run().summary["slo"]
+    assert sim_card is not None
+    assert sim_card["meta"]["source"] == "sim"
+
+    h = Harness()
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        pods = h.static_allocation_spark_pods("app-schema", 1)
+        h.assert_success(h.schedule(pods[0], ["n1", "n2"]))
+        h.wait_quiesced()
+        h.server.lifecycle.drain(trigger="test")
+        live_card = build_scorecard(
+            h.server.lifecycle, h.server.slo, meta={"source": "server"}
+        )
+    finally:
+        h.close()
+
+    assert _schema_tree(sim_card) == _schema_tree(live_card)
+    # and both digests are recomputable from their documents
+    assert sim_card["digest"] == scorecard_digest(sim_card)
+    assert live_card["digest"] == scorecard_digest(live_card)
+
+
+def test_scorecard_digest_ignores_meta_and_operational_counters():
+    engine = SloEngine()
+    card = build_scorecard(None, engine, meta={"source": "a"}, now=10.0)
+    twin = build_scorecard(None, engine, meta={"source": "b", "extra": 1}, now=10.0)
+    assert card["digest"] == twin["digest"]
+
+    drift = json.loads(json.dumps(card))
+    drift["lifecycle"] = {"gangs": 0, "drains": 99, "lockViolations": 0}
+    base = json.loads(json.dumps(card))
+    base["lifecycle"] = {"gangs": 0, "drains": 1, "lockViolations": 0}
+    # drain-loop cadence is operational, not policy: no digest churn
+    assert scorecard_digest(drift) == scorecard_digest(base)
+    assert scorecard_diff(base, drift) == []
+    # a policy-visible count DOES churn the digest
+    drift["lifecycle"]["gangs"] = 5
+    assert scorecard_digest(drift) != scorecard_digest(base)
+    assert scorecard_diff(base, drift) == [("lifecycle.gangs", 0, 5)]
+
+
+# -- policy-regression gate ---------------------------------------------------
+
+
+def _gate_main():
+    spec = importlib.util.spec_from_file_location(
+        "policy_regression", REPO / "tools" / "policy_regression.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_policy_regression_gate_exit_codes(tmp_path, capsys):
+    main = _gate_main()
+    card = build_scorecard(None, SloEngine(), meta={"source": "sim"}, now=5.0)
+    current = tmp_path / "current.json"
+    baseline = tmp_path / "baseline.json"
+    current.write_text(json.dumps(card))
+
+    # 2: no baseline yet
+    assert main(["--current", str(current), "--baseline", str(baseline)]) == 2
+
+    # --update seeds it → 0 on re-check
+    assert main(["--current", str(current), "--baseline", str(baseline), "--update"]) == 0
+    report = tmp_path / "report.json"
+    assert main(
+        ["--current", str(current), "--baseline", str(baseline), "--json", str(report)]
+    ) == 0
+    assert json.loads(report.read_text())["pass"] is True
+
+    # 1: seeded digest mismatch, with the drifted leaf named
+    seeded = json.loads(json.dumps(card))
+    seeded["lifecycle"] = {"gangs": 7}
+    drifted = tmp_path / "drifted.json"
+    drifted.write_text(json.dumps(seeded))
+    assert main(
+        ["--current", str(drifted), "--baseline", str(baseline), "--json", str(report)]
+    ) == 1
+    out = json.loads(report.read_text())
+    assert out["pass"] is False
+    assert any(d["path"] == "lifecycle.gangs" for d in out["diffs"])
+
+    # a hand-edited baseline digest cannot mask drift: digests are
+    # recomputed from the documents
+    forged = json.loads(baseline.read_text())
+    forged["digest"] = out["currentDigest"]
+    baseline.write_text(json.dumps(forged))
+    assert main(["--current", str(drifted), "--baseline", str(baseline)]) == 1
+
+    # 2: invalid JSON input
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main(["--current", str(bad), "--baseline", str(baseline)]) == 2
+
+
+def test_committed_chaos_baseline_is_internally_consistent():
+    """The committed baseline's stored digest must match its own body —
+    a hand-edited baseline is caught here, not first in CI."""
+    path = REPO / "tests" / "baselines" / "scorecard_chaos.json"
+    card = json.loads(path.read_text())
+    assert card["schema"]["name"] == SCHEMA_NAME
+    assert card["digest"] == scorecard_digest(card)
+    assert len(card["objectives"]) >= 4
+    assert card["lifecycle"]["gangs"] > 0

@@ -110,6 +110,41 @@ def test_every_catalog_name_documented_in_observability_md():
     )
 
 
+def test_runtime_emitted_metric_names_are_catalog_values():
+    """Drift check (lifecycle-ledger satellite): every metric name the
+    REAL wiring emits at runtime must be a declared catalog constant —
+    the AST literal scan above can't see a name built dynamically, so
+    this drives a scenario and audits the registry's actual keys."""
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+    h = Harness()
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        pods = h.static_allocation_spark_pods("app-audit", 1)
+        h.assert_success(h.schedule(pods[0], ["n1", "n2"]))
+        h.assert_success(h.schedule(pods[1], ["n1", "n2"]))
+        h.wait_quiesced()
+        h.server.reporters.report_once()
+        if h.server.lifecycle is not None:
+            h.server.lifecycle.drain(trigger="test")
+
+        catalog_values = set(_catalog().values())
+        collected = h.server.metrics.collect()
+        emitted = {
+            name
+            for kind in ("counters", "gauges", "histograms")
+            for (name, _tags) in collected[kind]
+        }
+        offenders = sorted(emitted - catalog_values)
+        assert not offenders, (
+            "runtime-emitted metric names missing from metrics/names.py:\n"
+            + "\n".join(offenders)
+        )
+    finally:
+        h.close()
+
+
 def test_tag_keys_match_reference():
     # metrics.go:70-85
     assert M.TAG_SPARK_ROLE == "sparkrole"

@@ -223,3 +223,85 @@ def test_prometheus_label_and_name_escaping():
 
 def test_prometheus_empty_registry():
     assert prom.render(MetricsRegistry()) == ""
+
+
+# -- OpenMetrics flavour (exemplars + EOF + content negotiation) --------------
+
+
+def _registry_with_all_families():
+    from k8s_spark_scheduler_tpu.tracing import Tracer
+
+    m = MetricsRegistry()
+    m.counter("foundry.spark.scheduler.requests", {"outcome": "success"}, inc=2)
+    m.gauge("foundry.spark.scheduler.packing.efficiency", 0.5)
+    tracer = Tracer()
+    with tracer.span("root", trace_id="tr-ex"):
+        m.histogram("foundry.spark.scheduler.schedule.time", 0.004, {"role": "driver"})
+    m.histogram("foundry.spark.scheduler.wait.time", 0.2)  # untraced: no exemplar
+    return m
+
+
+def test_openmetrics_exemplars_only_on_counterlike_lines():
+    """ISSUE satellite: exemplars may ride only on counter-like series
+    (the summary ``_count`` lines here) — never on gauges, quantiles,
+    ``_sum``, or the ``_max`` gauge family."""
+    text = prom.render(_registry_with_all_families(), openmetrics=True)
+    exemplar_lines = [l for l in text.split("\n") if " # {" in l]
+    assert exemplar_lines, "traced histogram observation produced no exemplar"
+    for line in exemplar_lines:
+        family = line.split("{", 1)[0]
+        assert family.endswith("_count"), line
+    assert 'trace_id="tr-ex"' in exemplar_lines[0]
+    # the untraced histogram's _count carries none
+    assert not any(
+        " # {" in l for l in text.split("\n")
+        if l.startswith("foundry_spark_scheduler_wait_time_count")
+    )
+    # plain mode: byte-identical exposition, zero exemplars, no EOF
+    plain = prom.render(_registry_with_all_families())
+    assert " # {" not in plain and "# EOF" not in plain
+
+
+def test_openmetrics_terminates_with_eof():
+    text = prom.render(_registry_with_all_families(), openmetrics=True)
+    assert text.endswith("# EOF\n")
+    assert text.count("# EOF") == 1
+    # mandatory even before the first recorded metric: a scrape of an
+    # idle registry must still parse as OpenMetrics
+    assert prom.render(MetricsRegistry(), openmetrics=True) == "# EOF\n"
+
+
+def test_metrics_content_negotiation(harness):
+    """?format=openmetrics is the ONLY route to the exemplar flavour
+    (with its content-type); any Accept header — openmetrics included —
+    gets the plain 0.0.4 text, per the documented policy that the
+    pragmatic exemplar flavour would fail a strict OpenMetrics parser."""
+    import urllib.request
+
+    from k8s_spark_scheduler_tpu.server.http import ExtenderHTTPServer
+
+    http = ExtenderHTTPServer(harness.server, port=0)
+    http.start()
+    try:
+        base = f"http://127.0.0.1:{http.port}/metrics"
+
+        def fetch(url, accept=None):
+            req = urllib.request.Request(url)
+            if accept:
+                req.add_header("Accept", accept)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.headers.get("Content-Type"), resp.read().decode()
+
+        ctype, body = fetch(base + "?format=openmetrics")
+        assert ctype == prom.CONTENT_TYPE_OPENMETRICS
+        assert body.endswith("# EOF\n")
+
+        for accept in ("application/openmetrics-text", "text/plain"):
+            ctype, body = fetch(base, accept=accept)
+            assert ctype == prom.CONTENT_TYPE, accept
+            assert "# EOF" not in body, accept
+
+        ctype, body = fetch(base)  # no Accept → JSON snapshot
+        assert ctype.startswith("application/json")
+    finally:
+        http.stop()

@@ -392,6 +392,53 @@ def test_capacity_sampler_overhead_within_budget():
         h.close()
 
 
+def test_lifecycle_ledger_overhead_within_budget():
+    """Lifecycle-ledger acceptance: the gang ledger adds zero work
+    under the predicate lock — everything originating inside the
+    predicate is pulled by cursor on the background drain thread, so
+    the only hot-path cost is the EventLog wakeup Event.set.  Budget
+    mirrors the capacity-sampler guard: enabled ≤ disabled × 1.05 plus
+    absolute CI-noise slack, and the structural check that the drain
+    never ran under the lock."""
+    from k8s_spark_scheduler_tpu.testing.harness import Harness
+    from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+
+    h = Harness(binpack_algo="tpu-batch", is_fifo=True)
+    try:
+        h.new_node("n1")
+        h.new_node("n2")
+        driver = h.static_allocation_spark_pods("app-ledger-perf", 1)[0]
+        h.assert_success(h.schedule(driver, ["n1", "n2"]))
+
+        extender = h.server.extender
+        ledger = h.server.lifecycle
+        assert ledger is not None
+        args = ExtenderArgs(pod=driver, node_names=["n1", "n2"])
+        n = 50
+
+        def batch():
+            for _ in range(n):
+                extender.predicate(args)
+
+        batch()  # warm caches/jit
+        ledger.stop()
+        disabled_s = _best_of(batch)
+        ledger.start()
+        batch()  # warm with the thread alive
+        enabled_s = _best_of(batch)
+
+        budget = disabled_s * 1.05 + n * 0.5e-3  # 5% relative + 0.5ms/request
+        assert enabled_s <= budget, (
+            f"lifecycle ledger overhead: {enabled_s * 1e3:.2f}ms per "
+            f"{n}-request batch enabled vs {disabled_s * 1e3:.2f}ms disabled "
+            f"(budget {budget * 1e3:.2f}ms)"
+        )
+        # and it never drained from inside the extender lock
+        assert ledger.lock_violations == 0
+    finally:
+        h.close()
+
+
 def test_racecheck_disabled_overhead_within_budget():
     """The race-detector checkpoints stay in the hot paths permanently,
     so their disabled cost is a contract: one module-attribute read and

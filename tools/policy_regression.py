@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Policy-regression gate over the sim's SLO scorecard.
+
+The chaos scenario is deterministic (same scenario + seed ⇒ byte-
+identical event log), so its scorecard — objective outcomes and
+lifecycle counts rendered by ``lifecycle/scorecard.py``, the SAME
+schema a live server serves on ``GET /slo`` — is a pure function of
+scheduler policy.  A committed baseline
+(``tests/baselines/scorecard_chaos.json``) therefore turns any
+behavioral policy change into a reviewable diff: CI re-runs the
+scenario, recomputes both digests, and fails when they diverge,
+printing the leaf-level paths that moved.
+
+    JAX_PLATFORMS=cpu python -m k8s_spark_scheduler_tpu.sim \
+        --scenario examples/sim/chaos.json --out /tmp/sim --quiet
+    python tools/policy_regression.py --current /tmp/sim/scorecard.json
+
+Digests are recomputed from the documents (never trusted from the
+files), so a hand-edited baseline digest cannot mask a drift.  An
+INTENDED policy change is landed by refreshing the baseline in the
+same PR: ``--update`` rewrites it from ``--current``, and the diff of
+the committed baseline IS the review artifact.
+
+Exit 0 = digests match; 1 = policy drift (or schema mismatch);
+2 = missing/invalid input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from k8s_spark_scheduler_tpu.lifecycle import (  # noqa: E402
+    scorecard_diff,
+    scorecard_digest,
+)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "tests", "baselines", "scorecard_chaos.json")
+
+
+def _load(path: str, label: str):
+    if not os.path.exists(path):
+        print(f"no {label} scorecard at {path}", file=sys.stderr)
+        return None
+    try:
+        with open(path) as f:
+            card = json.load(f)
+    except ValueError as exc:
+        print(f"{label} scorecard {path} is not valid JSON: {exc}", file=sys.stderr)
+        return None
+    if not isinstance(card, dict) or "schema" not in card:
+        print(f"{label} scorecard {path} has no schema block", file=sys.stderr)
+        return None
+    return card
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="scorecard policy-regression gate (sim vs committed baseline)"
+    )
+    parser.add_argument(
+        "--current",
+        required=True,
+        help="scorecard.json from a fresh sim run (sim --out <dir>)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help=f"committed baseline scorecard (default: {DEFAULT_BASELINE})",
+    )
+    parser.add_argument("--json", default=None, help="write the gate report here too")
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the baseline from --current (landing an intended policy change)",
+    )
+    args = parser.parse_args(argv)
+
+    current = _load(args.current, "current")
+    if current is None:
+        return 2
+
+    if args.update:
+        os.makedirs(os.path.dirname(args.baseline), exist_ok=True)
+        with open(args.baseline, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"baseline updated: {args.baseline} digest={scorecard_digest(current)}")
+        return 0
+
+    baseline = _load(args.baseline, "baseline")
+    if baseline is None:
+        return 2
+
+    current_digest = scorecard_digest(current)
+    baseline_digest = scorecard_digest(baseline)
+    schema_ok = current.get("schema") == baseline.get("schema")
+    diffs = scorecard_diff(baseline, current) if current_digest != baseline_digest else []
+
+    report = {
+        "current": os.path.basename(args.current),
+        "baseline": os.path.basename(args.baseline),
+        "currentDigest": current_digest,
+        "baselineDigest": baseline_digest,
+        "schemaMatch": schema_ok,
+        "diffs": [
+            {"path": path, "baseline": a, "current": b} for path, a, b in diffs
+        ],
+        "pass": schema_ok and current_digest == baseline_digest,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+
+    if report["pass"]:
+        print(f"policy-regression: PASS digest={current_digest}")
+        return 0
+    if not schema_ok:
+        print(
+            f"policy-regression: FAIL schema mismatch "
+            f"(baseline {baseline.get('schema')} vs current {current.get('schema')})",
+            file=sys.stderr,
+        )
+    print(
+        f"policy-regression: FAIL digest drift "
+        f"(baseline {baseline_digest} vs current {current_digest})",
+        file=sys.stderr,
+    )
+    for path, a, b in diffs:
+        print(f"  {path}: {a!r} -> {b!r}", file=sys.stderr)
+    print(
+        "intended policy change? refresh the baseline in this PR:\n"
+        f"  python tools/policy_regression.py --current {args.current} --update",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
