@@ -93,6 +93,13 @@ def scorecard_diff(a: Dict[str, Any], b: Dict[str, Any]) -> list:
 
 
 def _walk_diff(a: Any, b: Any, path: str, out: list) -> None:
+    # a whole nested block added/removed on one side: descend so every
+    # sub-leaf is reported against "<absent>" (actionable paths), rather
+    # than one opaque dict-valued tuple
+    if a == "<absent>" and isinstance(b, dict) and b:
+        a = {}
+    if b == "<absent>" and isinstance(a, dict) and a:
+        b = {}
     if isinstance(a, dict) and isinstance(b, dict):
         for key in sorted(set(a) | set(b)):
             _walk_diff(

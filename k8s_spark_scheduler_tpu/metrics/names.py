@@ -287,6 +287,18 @@ SIM_AUDIT_VIOLATIONS = (
     "foundry.spark.scheduler.tpu.sim.audit.violations.count"
 )
 
+# policy lab (lab/): trace synthesis + matrix evaluation harness
+# apps emitted by one synthesizer invocation
+LAB_TRACE_APPS = "foundry.spark.scheduler.tpu.lab.trace.apps"
+# cells executed per matrix run
+LAB_MATRIX_CELLS = "foundry.spark.scheduler.tpu.lab.matrix.cells"
+# per-cell replay wall time (seconds; histogram, tagged cell=)
+LAB_CELL_WALL_TIME = "foundry.spark.scheduler.tpu.lab.cell.wall.time"
+# per-cell replay event count (gauge, tagged cell=)
+LAB_CELL_EVENTS = "foundry.spark.scheduler.tpu.lab.cell.events.count"
+# per-cell gang evictions (gauge, tagged cell=)
+LAB_CELL_EVICTIONS = "foundry.spark.scheduler.tpu.lab.cell.evictions.count"
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"
@@ -307,6 +319,7 @@ TAG_SEGMENT = "segment"
 TAG_OBJECTIVE = "objective"
 TAG_WINDOW = "window"
 TAG_CAUSE = "cause"
+TAG_CELL = "cell"
 
 TICK_INTERVAL_SECONDS = 30.0
 SLOW_LOG_THRESHOLD_SECONDS = 45.0

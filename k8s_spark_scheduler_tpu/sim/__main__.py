@@ -21,10 +21,12 @@ reproduced exactly; a mismatch prints the diverging lane and exits 1.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import sys
 
+from .manifest import write_run_manifest
 from .runner import Simulation
 from .scenario import Scenario
 from .workload import WorkloadGenerator, dump_trace
@@ -117,6 +119,21 @@ def main(argv=None) -> int:
         if result.summary.get("slo") is not None:
             with open(os.path.join(args.out, "scorecard.json"), "w") as f:
                 json.dump(result.summary["slo"], f, indent=2, sort_keys=True)
+        # self-describing manifest: seed, scenario digest, and a
+        # sha256-addressed list of every artifact written above
+        scenario_blob = json.dumps(
+            scenario.to_dict(), sort_keys=True, separators=(",", ":")
+        )
+        write_run_manifest(
+            args.out,
+            kind="sim-run",
+            seed=scenario.seed,
+            digests={
+                "events": result.digest,
+                "scenario": hashlib.sha256(scenario_blob.encode()).hexdigest(),
+            },
+            extra={"scenario": scenario.name},
+        )
 
     if not args.quiet:
         json.dump(result.summary, sys.stdout, indent=2, sort_keys=True)

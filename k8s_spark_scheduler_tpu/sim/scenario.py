@@ -99,6 +99,111 @@ FAULT_KINDS = {
 }
 
 
+class ScenarioError(ValueError):
+    """Actionable scenario validation failure (raised up front by
+    ``Scenario.from_dict`` instead of a deep runner traceback)."""
+
+
+_SCENARIO_KEYS = {
+    "name", "seed", "duration", "retry_interval", "binpack_algo",
+    "fifo", "cluster", "workload", "autoscaler", "faults",
+    "unschedulable_scan_interval", "policy", "ha",
+}
+_CLUSTER_KEYS = {"nodes", "cpu", "memory", "gpu", "zones", "instance_group"}
+_AUTOSCALER_KEYS = {
+    "enabled", "delay", "max_nodes", "node_cpu", "node_memory", "node_gpu",
+}
+_FAULT_KEYS = {"at", "kind", "count", "apps", "fraction", "duration", "band"}
+_WORKLOAD_KEYS = {
+    "trace", "process", "rate_per_min", "executors", "dynamic_fraction",
+    "lifetime", "instance_group", "band_weights", "tenants", "band",
+    "tenant", "burst_interval", "burst_size", "burst_offset",
+    "peak_rate_per_min", "period",
+}
+_WORKLOAD_PROCESSES = {"poisson", "burst", "diurnal"}
+
+
+def _check_block(path: str, block, known: set) -> Dict:
+    if not isinstance(block, dict):
+        raise ScenarioError(
+            f"{path}: expected an object, got {type(block).__name__}"
+        )
+    unknown = set(block) - known
+    if unknown:
+        raise ScenarioError(
+            f"{path}: unknown keys {sorted(unknown)} (known: {sorted(known)})"
+        )
+    return block
+
+
+def _check_number(path: str, value, lo=None) -> None:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise ScenarioError(f"{path}: expected a number, got {value!r}")
+    if lo is not None and value < lo:
+        raise ScenarioError(f"{path}: must be >= {lo}, got {value!r}")
+
+
+def _validate_workload(block: Dict) -> None:
+    _check_block("scenario.workload", block, _WORKLOAD_KEYS)
+    if block.get("trace") is not None and not isinstance(block["trace"], str):
+        raise ScenarioError(
+            f"scenario.workload.trace: expected a path string, got {block['trace']!r}"
+        )
+    process = block.get("process", "poisson")
+    if process not in _WORKLOAD_PROCESSES:
+        raise ScenarioError(
+            f"scenario.workload.process: unknown process {process!r} "
+            f"(known: {sorted(_WORKLOAD_PROCESSES)})"
+        )
+    for key, bounds in (("executors", (1, None)), ("lifetime", (0, None))):
+        sub = block.get(key)
+        if sub is None:
+            continue
+        sub = _check_block(f"scenario.workload.{key}", sub, {"min", "max"})
+        for edge in ("min", "max"):
+            if edge in sub:
+                _check_number(f"scenario.workload.{key}.{edge}", sub[edge], lo=bounds[0] if edge == "min" else None)
+        if "min" in sub and "max" in sub and sub["max"] < sub["min"]:
+            raise ScenarioError(
+                f"scenario.workload.{key}: max {sub['max']} < min {sub['min']}"
+            )
+    if "dynamic_fraction" in block:
+        _check_number("scenario.workload.dynamic_fraction", block["dynamic_fraction"], lo=0.0)
+        if block["dynamic_fraction"] > 1.0:
+            raise ScenarioError(
+                f"scenario.workload.dynamic_fraction: must be <= 1.0, "
+                f"got {block['dynamic_fraction']!r}"
+            )
+
+
+def _validate_faults(faults) -> None:
+    if not isinstance(faults, list):
+        raise ScenarioError(
+            f"scenario.faults: expected a list, got {type(faults).__name__}"
+        )
+    for i, f in enumerate(faults):
+        if not isinstance(f, dict):
+            raise ScenarioError(
+                f"scenario.faults[{i}]: expected an object, got {type(f).__name__}"
+            )
+        unknown = set(f) - _FAULT_KEYS
+        if unknown:
+            raise ScenarioError(
+                f"scenario.faults[{i}]: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(_FAULT_KEYS)})"
+            )
+        if "kind" not in f:
+            raise ScenarioError(f"scenario.faults[{i}]: missing required key 'kind'")
+        if f["kind"] not in FAULT_KINDS:
+            raise ScenarioError(
+                f"scenario.faults[{i}].kind: unknown fault kind {f['kind']!r} "
+                f"(known: {sorted(FAULT_KINDS)})"
+            )
+        if "at" not in f:
+            raise ScenarioError(f"scenario.faults[{i}]: missing required key 'at'")
+        _check_number(f"scenario.faults[{i}].at", f["at"], lo=0)
+
+
 @dataclass
 class ClusterSpec:
     nodes: int = 4
@@ -163,17 +268,37 @@ class Scenario:
 
     @staticmethod
     def from_dict(d: Dict) -> "Scenario":
+        if not isinstance(d, dict):
+            raise ScenarioError(
+                f"scenario: expected an object, got {type(d).__name__}"
+            )
         d = dict(d)
-        unknown = set(d) - {
-            "name", "seed", "duration", "retry_interval", "binpack_algo",
-            "fifo", "cluster", "workload", "autoscaler", "faults",
-            "unschedulable_scan_interval", "policy", "ha",
-        }
+        unknown = set(d) - _SCENARIO_KEYS
         if unknown:
-            raise ValueError(f"unknown scenario keys: {sorted(unknown)}")
-        cluster = ClusterSpec(**d.pop("cluster", {}))
-        autoscaler = AutoscalerSpec(**d.pop("autoscaler", {}))
-        faults = [FaultSpec(**f) for f in d.pop("faults", [])]
+            raise ScenarioError(
+                f"scenario: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(_SCENARIO_KEYS)})"
+            )
+        for key in ("duration", "retry_interval", "seed"):
+            if key in d:
+                _check_number(f"scenario.{key}", d[key], lo=0)
+        cluster_d = _check_block("scenario.cluster", d.pop("cluster", {}), _CLUSTER_KEYS)
+        if "nodes" in cluster_d:
+            _check_number("scenario.cluster.nodes", cluster_d["nodes"], lo=0)
+        autoscaler_d = _check_block(
+            "scenario.autoscaler", d.pop("autoscaler", {}), _AUTOSCALER_KEYS
+        )
+        faults_d = d.pop("faults", [])
+        _validate_faults(faults_d)
+        _validate_workload(d.get("workload", {}))
+        for key in ("policy", "ha"):
+            if key in d and not isinstance(d[key], dict):
+                raise ScenarioError(
+                    f"scenario.{key}: expected an object, got {type(d[key]).__name__}"
+                )
+        cluster = ClusterSpec(**cluster_d)
+        autoscaler = AutoscalerSpec(**autoscaler_d)
+        faults = [FaultSpec(**f) for f in faults_d]
         faults.sort(key=lambda f: (f.at, f.kind))
         return Scenario(cluster=cluster, autoscaler=autoscaler, faults=faults, **d)
 

@@ -353,7 +353,7 @@ def test_sim_and_live_scorecards_share_schema():
             "seed": 3,
             "duration": 120,
             "cluster": {"nodes": 4},
-            "workload": {"arrival": {"rate_per_min": 2.0}},
+            "workload": {"rate_per_min": 2.0},
         }
     )
     sim_card = Simulation(sc).run().summary["slo"]
@@ -452,6 +452,131 @@ def test_policy_regression_gate_exit_codes(tmp_path, capsys):
     bad = tmp_path / "bad.json"
     bad.write_text("{not json")
     assert main(["--current", str(bad), "--baseline", str(baseline)]) == 2
+
+
+def test_scorecard_diff_edge_cases():
+    """Leaf-walk robustness (lab-PR satellite): missing leaves, type
+    changes, and nested additions must each surface as explicit (path,
+    a, b) tuples — not crash, not vanish."""
+    base = build_scorecard(None, SloEngine(), meta={"source": "a"}, now=10.0)
+    objective = next(iter(base["objectives"]))
+
+    # missing leaf: one side lost a nested key entirely
+    lost = json.loads(json.dumps(base))
+    removed = lost["objectives"][objective].pop("target")
+    diffs = scorecard_diff(base, lost)
+    assert (f"objectives.{objective}.target", removed, "<absent>") in diffs
+
+    # type change: scalar leaf became an object — reported as one leaf
+    # holding both shapes rather than raising on the mixed walk
+    typed = json.loads(json.dumps(base))
+    typed["objectives"][objective]["target"] = {"value": removed, "unit": "s"}
+    diffs = scorecard_diff(base, typed)
+    assert (
+        f"objectives.{objective}.target",
+        removed,
+        {"value": removed, "unit": "s"},
+    ) in diffs
+
+    # nested addition: a whole new objective appears on one side
+    grown = json.loads(json.dumps(base))
+    grown["objectives"]["gpu_wait"] = {"target": 0.99, "state": "ok"}
+    diffs = scorecard_diff(base, grown)
+    assert ("objectives.gpu_wait.target", "<absent>", 0.99) in diffs
+    assert ("objectives.gpu_wait.state", "<absent>", "ok") in diffs
+    # and the walk is symmetric
+    assert ("objectives.gpu_wait.target", 0.99, "<absent>") in scorecard_diff(grown, base)
+
+    # float exposition noise below the canonical rounding is NOT a diff
+    noisy = json.loads(json.dumps(base))
+    noisy["objectives"][objective]["target"] = removed + 1e-12
+    assert scorecard_diff(base, noisy) == []
+
+
+def test_policy_regression_matrix_gate_exit_codes(tmp_path, capsys):
+    """Matrix-mode gate (lab PR): 2 on malformed/forged inputs, 0 after
+    --update, 1 on drifted or missing cells — mirroring the scorecard
+    mode's contract."""
+    main = _gate_main()
+    card = build_scorecard(None, SloEngine(), meta={"source": "lab"}, now=5.0)
+    cell = {
+        "cell": "fifo",
+        "axes": {"ordering": "fifo"},
+        "scorecard": card,
+        "eventsDigest": "e" * 64,
+        "kpis": {"packing_efficiency": {"max": 0.5}},
+    }
+    matrix = {"schema": "tpu-gang-scheduler-matrix", "version": 1, "cells": [cell]}
+    current = tmp_path / "matrix.json"
+    baseline = tmp_path / "baseline.json"
+    current.write_text(json.dumps(matrix))
+
+    # exactly one mode may be selected
+    with pytest.raises(SystemExit):
+        main([])
+    with pytest.raises(SystemExit):
+        main(["--current", "x.json", "--matrix-current", "y.json"])
+
+    # 2: no baseline yet; --update seeds it -> 0 on re-check
+    args = ["--matrix-current", str(current), "--matrix-baseline", str(baseline)]
+    assert main(args) == 2
+    assert main(args + ["--update"]) == 0
+    report = tmp_path / "report.json"
+    assert main(args + ["--json", str(report)]) == 0
+    assert json.loads(report.read_text())["pass"] is True
+
+    # 2: malformed current (invalid JSON / no schema / no cells list)
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main(["--matrix-current", str(bad), "--matrix-baseline", str(baseline)]) == 2
+    bad.write_text(json.dumps({"cells": []}))
+    assert main(["--matrix-current", str(bad), "--matrix-baseline", str(baseline)]) == 2
+    bad.write_text(json.dumps({"schema": "tpu-gang-scheduler-matrix", "cells": "nope"}))
+    assert main(["--matrix-current", str(bad), "--matrix-baseline", str(baseline)]) == 2
+
+    # 1: a cell's scorecard drifted — the leaf is named in the report
+    drifted_doc = json.loads(json.dumps(matrix))
+    drifted_doc["cells"][0]["scorecard"]["lifecycle"] = {"gangs": 9}
+    drifted = tmp_path / "drifted.json"
+    drifted.write_text(json.dumps(drifted_doc))
+    assert main(
+        ["--matrix-current", str(drifted), "--matrix-baseline", str(baseline),
+         "--json", str(report)]
+    ) == 1
+    out = json.loads(report.read_text())
+    assert out["pass"] is False
+    assert out["driftedCells"][0]["cell"] == "fifo"
+    assert any(
+        d["path"] == "lifecycle.gangs" for d in out["driftedCells"][0]["diffs"]
+    )
+
+    # 1: KPI drift alone (same scorecard) still trips the composite digest
+    kpi_drift = json.loads(json.dumps(matrix))
+    kpi_drift["cells"][0]["kpis"]["packing_efficiency"]["max"] = 0.9
+    drifted.write_text(json.dumps(kpi_drift))
+    assert main(
+        ["--matrix-current", str(drifted), "--matrix-baseline", str(baseline),
+         "--json", str(report)]
+    ) == 1
+    assert json.loads(report.read_text())["driftedCells"][0]["diffs"] == []
+
+    # 1: a baseline cell missing from the current run
+    empty = json.loads(json.dumps(matrix))
+    empty["cells"] = []
+    drifted.write_text(json.dumps(empty))
+    assert main(
+        ["--matrix-current", str(drifted), "--matrix-baseline", str(baseline),
+         "--json", str(report)]
+    ) == 1
+    assert json.loads(report.read_text())["missingCells"] == ["fifo"]
+
+    # forged baseline digests are ignored: the gate recomputes from the
+    # documents, so editing the stored strings cannot mask a stale body
+    forged = json.loads(baseline.read_text())
+    forged["cells"][0]["scorecard"]["lifecycle"] = {"gangs": 1}
+    baseline.write_text(json.dumps(forged))
+    drifted.write_text(json.dumps(matrix))
+    assert main(["--matrix-current", str(drifted), "--matrix-baseline", str(baseline)]) == 1
 
 
 def test_committed_chaos_baseline_is_internally_consistent():

@@ -133,7 +133,7 @@ def test_scenario_from_dict_and_validation():
     )
     assert sc.cluster.nodes == 2
     assert [f.kind for f in sc.faults] == ["node_kill", "failover"]  # sorted by time
-    with pytest.raises(ValueError, match="unknown scenario keys"):
+    with pytest.raises(ValueError, match="scenario: unknown keys"):
         Scenario.from_dict({"naem": "typo"})
     with pytest.raises(ValueError, match="unknown fault kind"):
         Scenario.from_dict({"faults": [{"at": 1, "kind": "meteor"}]})

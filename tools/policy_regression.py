@@ -21,6 +21,19 @@ INTENDED policy change is landed by refreshing the baseline in the
 same PR: ``--update`` rewrites it from ``--current``, and the diff of
 the committed baseline IS the review artifact.
 
+The gate also runs in **matrix mode** over the policy lab's output
+(``python -m k8s_spark_scheduler_tpu.lab run``): ``--matrix-current``
+compares every cell of a fresh matrix.json against the committed
+multi-cell baseline (``tests/baselines/matrix_smoke.json``), so one
+gate covers the whole policy surface — ordering × preemption ×
+backfill — instead of the single chaos scenario.  Per-cell scorecard
+digests AND the composite cell digests are recomputed from the
+documents; drifted cells print their leaf-level scorecard diffs.
+
+    python -m k8s_spark_scheduler_tpu.lab run --spec examples/lab/smoke_matrix.json \
+        --out /tmp/lab-smoke
+    python tools/policy_regression.py --matrix-current /tmp/lab-smoke/matrix.json
+
 Exit 0 = digests match; 1 = policy drift (or schema mismatch);
 2 = missing/invalid input.
 """
@@ -41,6 +54,9 @@ from k8s_spark_scheduler_tpu.lifecycle import (  # noqa: E402
 )
 
 DEFAULT_BASELINE = os.path.join(_REPO, "tests", "baselines", "scorecard_chaos.json")
+DEFAULT_MATRIX_BASELINE = os.path.join(
+    _REPO, "tests", "baselines", "matrix_smoke.json"
+)
 
 
 def _load(path: str, label: str):
@@ -59,13 +75,131 @@ def _load(path: str, label: str):
     return card
 
 
+def _cell_digests(doc):
+    """Recompute a cell's scorecard digest and composite digest from
+    the document bodies (stored digests are never trusted)."""
+    from k8s_spark_scheduler_tpu.lab.engine import compute_cell_digest
+
+    sc_digest = scorecard_digest(doc.get("scorecard", {}))
+    cell_digest = compute_cell_digest(
+        sc_digest, doc.get("eventsDigest", ""), doc.get("kpis", {})
+    )
+    return sc_digest, cell_digest
+
+
+def _matrix_gate(args) -> int:
+    current = _load(args.matrix_current, "current matrix")
+    if current is None or not isinstance(current.get("cells"), list):
+        if current is not None:
+            print(
+                f"current matrix {args.matrix_current} has no cells list",
+                file=sys.stderr,
+            )
+        return 2
+
+    if args.update:
+        os.makedirs(os.path.dirname(args.matrix_baseline), exist_ok=True)
+        with open(args.matrix_baseline, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"matrix baseline updated: {args.matrix_baseline}")
+        return 0
+
+    baseline = _load(args.matrix_baseline, "baseline matrix")
+    if baseline is None or not isinstance(baseline.get("cells"), list):
+        if baseline is not None:
+            print(
+                f"baseline matrix {args.matrix_baseline} has no cells list",
+                file=sys.stderr,
+            )
+        return 2
+
+    schema_ok = current.get("schema") == baseline.get("schema")
+    current_by_id = {c.get("cell"): c for c in current["cells"]}
+    drifted = []
+    missing = []
+    for base_cell in baseline["cells"]:
+        cell_id = base_cell.get("cell")
+        cur_cell = current_by_id.get(cell_id)
+        if cur_cell is None:
+            missing.append(cell_id)
+            continue
+        base_sc, base_digest = _cell_digests(base_cell)
+        cur_sc, cur_digest = _cell_digests(cur_cell)
+        if base_digest != cur_digest:
+            diffs = (
+                scorecard_diff(base_cell["scorecard"], cur_cell["scorecard"])
+                if base_sc != cur_sc
+                else []
+            )
+            drifted.append((cell_id, base_digest, cur_digest, diffs))
+
+    report = {
+        "mode": "matrix",
+        "current": os.path.basename(args.matrix_current),
+        "baseline": os.path.basename(args.matrix_baseline),
+        "schemaMatch": schema_ok,
+        "cells": len(baseline["cells"]),
+        "missingCells": missing,
+        "driftedCells": [
+            {
+                "cell": cell_id,
+                "baselineDigest": a,
+                "currentDigest": b,
+                "diffs": [
+                    {"path": p, "baseline": x, "current": y} for p, x, y in diffs
+                ],
+            }
+            for cell_id, a, b, diffs in drifted
+        ],
+        "pass": schema_ok and not drifted and not missing,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+
+    if report["pass"]:
+        print(
+            f"policy-regression(matrix): PASS "
+            f"{len(baseline['cells'])} cells byte-identical"
+        )
+        return 0
+    if not schema_ok:
+        print(
+            f"policy-regression(matrix): FAIL schema mismatch "
+            f"(baseline {baseline.get('schema')!r} vs current {current.get('schema')!r})",
+            file=sys.stderr,
+        )
+    for cell_id in missing:
+        print(
+            f"policy-regression(matrix): FAIL cell {cell_id!r} missing from current",
+            file=sys.stderr,
+        )
+    for cell_id, a, b, diffs in drifted:
+        print(
+            f"policy-regression(matrix): FAIL cell {cell_id!r} drift "
+            f"(baseline {a} vs current {b})",
+            file=sys.stderr,
+        )
+        for path, x, y in diffs:
+            print(f"  {path}: {x!r} -> {y!r}", file=sys.stderr)
+    print(
+        "intended policy change? refresh the matrix baseline in this PR:\n"
+        f"  python tools/policy_regression.py --matrix-current "
+        f"{args.matrix_current} --update",
+        file=sys.stderr,
+    )
+    return 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="scorecard policy-regression gate (sim vs committed baseline)"
     )
     parser.add_argument(
         "--current",
-        required=True,
+        default=None,
         help="scorecard.json from a fresh sim run (sim --out <dir>)",
     )
     parser.add_argument(
@@ -73,13 +207,29 @@ def main(argv=None) -> int:
         default=DEFAULT_BASELINE,
         help=f"committed baseline scorecard (default: {DEFAULT_BASELINE})",
     )
+    parser.add_argument(
+        "--matrix-current",
+        default=None,
+        help="matrix.json from a fresh lab run (lab run --out <dir>)",
+    )
+    parser.add_argument(
+        "--matrix-baseline",
+        default=DEFAULT_MATRIX_BASELINE,
+        help=f"committed baseline matrix (default: {DEFAULT_MATRIX_BASELINE})",
+    )
     parser.add_argument("--json", default=None, help="write the gate report here too")
     parser.add_argument(
         "--update",
         action="store_true",
-        help="rewrite the baseline from --current (landing an intended policy change)",
+        help="rewrite the baseline from the current document "
+        "(landing an intended policy change)",
     )
     args = parser.parse_args(argv)
+
+    if (args.current is None) == (args.matrix_current is None):
+        parser.error("exactly one of --current / --matrix-current is required")
+    if args.matrix_current is not None:
+        return _matrix_gate(args)
 
     current = _load(args.current, "current")
     if current is None:
