@@ -49,11 +49,19 @@ SPAN_SEGMENTS: Dict[str, str] = {
     "binpack": "solve",
     "fast_path.build_tensor": "solve",
     "executor.fast_reschedule": "solve",
+    # the concurrent engine's speculative solve runs pre-lock on the
+    # request's own thread; classifying it apart from "solve" keeps the
+    # lock-tenure segment honest when speculation is on (a consumed
+    # verdict means the under-lock solve never ran)
+    "speculation.solve": "speculate",
     "reservation.writeback": "write-back",
     "state.writeback.enqueue": "write-back",
 }
 
-SEGMENT_NAMES = ("gate-queue", "lock-wait", "serde", "solve", "write-back", "other")
+SEGMENT_NAMES = (
+    "gate-queue", "lock-wait", "serde", "solve", "speculate", "write-back",
+    "other",
+)
 
 
 def decompose(root) -> Optional[Dict[str, Any]]:

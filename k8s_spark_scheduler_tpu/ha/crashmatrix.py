@@ -51,7 +51,13 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..config import HAConfig, Install, PolicyConfig, ResilienceConfig
+from ..config import (
+    ConcurrentConfig,
+    HAConfig,
+    Install,
+    PolicyConfig,
+    ResilienceConfig,
+)
 from ..kube.apiserver import APIServer
 from ..kube.crd import DEMAND_CRD_NAME, demand_crd_spec
 from ..kube.errors import APIError
@@ -80,6 +86,13 @@ _PREEMPT_POINTS = {
 _DIVERT_POINTS = {
     crashpoint.JOURNAL_PRE_APPEND,
     crashpoint.JOURNAL_POST_APPEND,
+}
+# speculation→commit window points (concurrent/engine.py): fire
+# synchronously on the Filter caller's thread inside engine.predicate
+_CONCURRENT_POINTS = {
+    crashpoint.CONCURRENT_SPECULATION_SOLVED,
+    crashpoint.CONCURRENT_COMMIT_REVALIDATED,
+    crashpoint.CONCURRENT_COMMIT_WRITTEN,
 }
 
 
@@ -122,6 +135,10 @@ class CrashMatrix:
                 lease_duration_seconds=_LEASE_TTL_S,
                 identity=identity,
             ),
+            # every cell's Filter traffic runs through the concurrent
+            # admission engine, so the speculation→commit window's crash
+            # points sit on the live request path
+            concurrent=ConcurrentConfig(enabled=True),
         )
 
     def _boot(self, api: APIServer, identity: str, journal_path: str):
@@ -173,9 +190,13 @@ class CrashMatrix:
             api.create(pod)
         node_names = sorted(n.name for n in api.list(Node.KIND))
         bound = []
+        engine = getattr(server, "concurrent", None)
+        predicate = (
+            engine.predicate if engine is not None else server.extender.predicate
+        )
         for pod in pods:
             fresh = api.get(Pod.KIND, pod.namespace, pod.name)
-            result = server.extender.predicate(
+            result = predicate(
                 ExtenderArgs(pod=fresh, node_names=list(node_names))
             )
             if result.node_names:
@@ -316,6 +337,18 @@ class CrashMatrix:
                 fired = _wait(lambda: crashpoint.armed() is None)
             return fired
 
+        if point in _CONCURRENT_POINTS:
+            # the speculation→commit window: the point fires on the
+            # Filter caller's thread inside engine.predicate — before
+            # the commit for speculation-solved / commit-revalidated,
+            # after the reservation write-back for commit-written
+            crashpoint.arm(point)
+            try:
+                self._schedule_app(server, api, "app-001")
+            except SimulatedCrash:
+                return True
+            return False
+
         # write-back commit and journal-ack points fire on the worker
         # thread during the very first reservation write
         crashpoint.arm(point)
@@ -342,6 +375,23 @@ class CrashMatrix:
                 except NotFoundError:
                     continue
                 violations.append(f"victim pod {name} still exists")
+        if point in _CONCURRENT_POINTS:
+            # exactly-once across the restart: a crash BEFORE the commit
+            # leaves zero reservation state (the gang was never
+            # admitted; the retry re-admits); a crash AFTER the
+            # reservation write leaves either the complete reservation
+            # or none (the bind never happened, so an unflushed write-
+            # back losing the race is still all-or-nothing) — never a
+            # half-committed gang
+            rr = cache.get("default", "app-001")
+            report["reservationPresent"] = rr is not None
+            if point != crashpoint.CONCURRENT_COMMIT_WRITTEN:
+                if rr is not None:
+                    violations.append(
+                        "crash before commit left a reservation for app-001"
+                    )
+            elif rr is not None and not rr.spec.reservations:
+                violations.append("app-001 reservation survived half-committed")
         if report["journalDepth"] != 0:
             violations.append(f"{report['journalDepth']} write intents still pending")
         if report["evictJournalDepth"] != 0:

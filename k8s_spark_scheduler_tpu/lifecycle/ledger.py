@@ -51,8 +51,12 @@ PHASES: Tuple[str, ...] = (
     "completed",
     "evicted",
     "expired",
+    # admission-gate shed: terminal for the Filter ATTEMPT (the request
+    # answered fail-fast without a solve), but revivable — kube-scheduler
+    # retries Pending pods, and the retry re-enters the lifecycle
+    "shed",
 )
-TERMINAL = frozenset(("completed", "evicted", "expired"))
+TERMINAL = frozenset(("completed", "evicted", "expired", "shed"))
 _PHASE_RANK = {p: i for i, p in enumerate(PHASES)}
 
 
@@ -347,7 +351,12 @@ class LifecycleLedger:
         if phase == current:
             return False
         re_terminal = phase in TERMINAL and bool(cause)
-        if _PHASE_RANK[phase] < _PHASE_RANK[current] and not re_terminal:
+        # "shed" is the one escapable terminal: the gang was never
+        # admitted, so a retried Filter revives it into the live phases
+        revival = current == "shed" and phase not in TERMINAL
+        if _PHASE_RANK[phase] < _PHASE_RANK[current] and not (
+            re_terminal or revival
+        ):
             # drains lag the informer path, so an earlier phase (e.g.
             # "solving" off the event log) can arrive after "bound" was
             # observed live — record its first-arrival time without
@@ -355,7 +364,7 @@ class LifecycleLedger:
             if phase not in TERMINAL and current not in TERMINAL:
                 record.phase_times.setdefault(phase, now)  # schedlint: disable=LK001 -- _advance_locked is only called with _lock held (see callers)
             return False
-        if current in TERMINAL and not re_terminal:
+        if current in TERMINAL and not (re_terminal or revival):
             return False
         record.phase = phase
         record.phase_times.setdefault(phase, now)
@@ -428,6 +437,31 @@ class LifecycleLedger:
                 and record.executors_bound >= max(record.min_executors, 1)
             ):
                 self._advance_locked(record, "running", now)
+
+    def mark_shed(self, pod) -> None:
+        """An AdmissionGate shed answered this gang's Filter without a
+        solve — record the verdict so shed gangs are visible in the
+        ledger instead of silently vanishing.  Terminal for the attempt
+        only: kube-scheduler retries Pending pods, and the retry's next
+        transition revives the record out of ``shed``."""
+        from ..scheduler import labels as L
+
+        app_id = pod.labels.get(L.SPARK_APP_ID_LABEL, "")
+        if not app_id:
+            return
+        now = timesource.now()
+        with self._lock:
+            record = self._record_locked(app_id, now)
+            if not record.namespace:
+                record.namespace = pod.namespace
+            if (
+                pod.labels.get(L.SPARK_ROLE_LABEL) == L.DRIVER
+                and not record.driver_pod
+            ):
+                record.driver_pod = pod.name
+                racecheck.note_access(self, "_by_driver")
+                self._by_driver[pod.name] = app_id
+            self._advance_locked(record, "shed", now)
 
     # -- drain (cursor consumers; never under the predicate lock) -------------
 

@@ -362,6 +362,31 @@ class ProvenanceTracker:
                         mnames.PROVENANCE_SHORTFALL, 0.0, {"dim": name}
                     )
 
+    def record_shed(self, pod) -> None:
+        """An AdmissionGate shed answered this request before the
+        extender ran — no begin_decision, no pending slot, no solve.
+        Record the verdict directly so ``/explain`` and
+        ``/debug/schedule`` can answer "why did my app not start?" for
+        shed requests too (outcome ``shed``; retriable by design)."""
+        if not self.enabled:
+            return
+        from ..scheduler import labels as L
+
+        rec = DecisionRecord(
+            pod=pod.name,
+            namespace=pod.namespace,
+            role=pod.labels.get(L.SPARK_ROLE_LABEL, ""),
+            trace_id=tracing.current_trace_id(),
+            t=timesource.now(),
+            outcome="shed",
+            message="admission gate shed: scheduler overloaded; retry",
+        )
+        self.ring.record(rec)
+        if self._metrics is not None:
+            self._metrics.gauge(
+                mnames.PROVENANCE_RECORDS, float(len(self.ring))
+            )
+
     # -- triggers (any thread) -----------------------------------------------
 
     def on_trigger(self, trigger: str, detail: str = "") -> Optional[str]:

@@ -602,9 +602,19 @@ class _Handler(BaseHTTPRequestHandler):
         scheduling cycle, a failed-nodes response just requeues the pod."""
         from ..types.extenderapi import ExtenderFilterResult
 
+        # the concurrent admission engine (concurrent/engine.py) is a
+        # drop-in for extender.predicate: speculative solve on THIS
+        # request thread, then a FIFO-ordered commit through the serial
+        # extender — decisions stay byte-identical to serial operation
+        engine = getattr(self.scheduler, "concurrent", None)
+        predicate = (
+            engine.predicate
+            if engine is not None
+            else self.scheduler.extender.predicate
+        )
         kit = getattr(self.scheduler, "resilience", None)
         if kit is None:
-            return self.scheduler.extender.predicate(args)
+            return predicate(args)
         try:
             # admission-gate queueing is a named critical-path segment;
             # today's gate is non-blocking (admit-or-shed) so this is
@@ -618,11 +628,27 @@ class _Handler(BaseHTTPRequestHandler):
                         (time.perf_counter() - t_gate) * 1000.0, 4
                     )
                 with req_deadline.bind(kit.request_timeout):
-                    return self.scheduler.extender.predicate(args)
+                    return predicate(args)
         except AdmissionShed:
             span = tracing.current_span()
             if span is not None:
+                # the extender never ran, so nothing else stamps the
+                # pod identity — without these tags the shed trace is
+                # unfindable via /debug/schedule/<pod>
+                span.tag("pod", args.pod.name)
+                span.tag("namespace", args.pod.namespace)
                 span.tag("outcome", "shed")
+            # a shed is a real terminal verdict for this Filter attempt:
+            # it must leave the same audit trail a refusal does — a
+            # provenance DecisionRecord (`/explain` answers "why did my
+            # app not start?" for sheds too) and a lifecycle `shed`
+            # phase mark, not just a counter bump
+            tracker = getattr(self.scheduler, "provenance", None)
+            if tracker is not None:
+                tracker.record_shed(args.pod)
+            ledger = getattr(self.scheduler, "lifecycle", None)
+            if ledger is not None:
+                ledger.mark_shed(args.pod)
             message = "scheduler overloaded; retry"
             return ExtenderFilterResult(
                 failed_nodes={n: message for n in args.node_names},

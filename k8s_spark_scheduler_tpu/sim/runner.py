@@ -151,12 +151,18 @@ class Simulation:
         # chaos tests assert zero reports after the run
         racecheck.enable_if_env()
         extra_install = None
-        if sc.policy or sc.ha:
-            # thread the scenario's policy/ha blocks into the REAL
-            # wiring: the harness builds the same Install it would by
-            # default, plus the policy engine / HA fabric
-            # (server/wiring.py)
-            from ..config import FifoConfig, HAConfig, Install, PolicyConfig
+        if sc.policy or sc.ha or sc.concurrent:
+            # thread the scenario's policy/ha/concurrent blocks into the
+            # REAL wiring: the harness builds the same Install it would
+            # by default, plus the policy engine / HA fabric /
+            # concurrent admission engine (server/wiring.py)
+            from ..config import (
+                ConcurrentConfig,
+                FifoConfig,
+                HAConfig,
+                Install,
+                PolicyConfig,
+            )
 
             kwargs = {}
             if sc.policy:
@@ -170,6 +176,11 @@ class Simulation:
                 ha_cfg.enabled = True
                 ha_cfg.background = False
                 kwargs["ha"] = ha_cfg
+            if sc.concurrent:
+                conc_cfg = ConcurrentConfig.from_dict(sc.concurrent)
+                # presence of the block is the opt-in, mirroring ha
+                conc_cfg.enabled = True
+                kwargs["concurrent"] = conc_cfg
             extra_install = Install(
                 fifo=sc.fifo,
                 fifo_config=FifoConfig(),

@@ -107,7 +107,7 @@ class ScenarioError(ValueError):
 _SCENARIO_KEYS = {
     "name", "seed", "duration", "retry_interval", "binpack_algo",
     "fifo", "cluster", "workload", "autoscaler", "faults",
-    "unschedulable_scan_interval", "policy", "ha",
+    "unschedulable_scan_interval", "policy", "ha", "concurrent",
 }
 _CLUSTER_KEYS = {"nodes", "cpu", "memory", "gpu", "zones", "instance_group"}
 _AUTOSCALER_KEYS = {
@@ -265,6 +265,12 @@ class Scenario:
     # no fabric.  background is forced off — the sim steps elections
     # on the virtual clock
     ha: Dict = field(default_factory=dict)
+    # Install.concurrent overrides (kebab-case,
+    # ConcurrentConfig.from_dict); empty = serial admission.  When
+    # enabled, every sim Filter routes through the concurrent engine's
+    # speculate→FIFO-commit path — decisions must stay byte-identical
+    # to the serial run of the same scenario
+    concurrent: Dict = field(default_factory=dict)
 
     @staticmethod
     def from_dict(d: Dict) -> "Scenario":
@@ -291,7 +297,7 @@ class Scenario:
         faults_d = d.pop("faults", [])
         _validate_faults(faults_d)
         _validate_workload(d.get("workload", {}))
-        for key in ("policy", "ha"):
+        for key in ("policy", "ha", "concurrent"):
             if key in d and not isinstance(d[key], dict):
                 raise ScenarioError(
                     f"scenario.{key}: expected an object, got {type(d[key]).__name__}"

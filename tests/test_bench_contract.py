@@ -17,6 +17,7 @@ def test_bench_final_line_is_the_headline(tmp_path):
     env.update(
         BENCH_NODES="120", BENCH_APPS="12", BENCH_CHAIN="2",
         BENCH_ROUNDS="2", BENCH_TPU_BUDGET_S="0", BENCH_E2E_PROBES="2",
+        BENCH_CONCURRENT_PROBES="8",
         BENCH_NO_COMMIT="1", JAX_PLATFORMS="cpu",
         BENCH_JAX_CACHE=str(tmp_path / "cache"),
     )
@@ -152,8 +153,37 @@ def test_bench_final_line_is_the_headline(tmp_path):
         # total p99, and coverage keeps "other" small)
         assert headline["criticalpath_coverage_p50"] == con["coverage_p50"]
         assert headline["criticalpath_dominant"] in (
-            "solve", "serde", "write-back", "gate-queue", "lock-wait", "other",
+            "solve", "serde", "write-back", "gate-queue", "lock-wait",
+            "speculate", "other",
         )
+
+        # concurrent-admission contract (ISSUE 18): the e2e phase pushes
+        # the same probe workload through the speculate→FIFO-commit
+        # engine at 1/2/4/8 client threads against the live server, and
+        # the lane must prove byte-identity to the serial extender every
+        # round.  tools/perf_regression.py band-gates the lane's p99_ms
+        # (8-client request latency, gate wait included), so the key
+        # names are part of the durable artifact contract.
+        ca = artifact["lanes"].get("concurrent-admission cpu")
+        assert ca is not None, "e2e phase ran but no concurrent-admission lane"
+        assert ca["probes"] == 8
+        assert ca["serial_dps"] > 0
+        assert ca["solve_p50_ms"] > 0
+        assert ca["p99_ms"] > 0
+        assert set(ca["clients"]) == {"1", "2", "4", "8"}
+        for cl in ca["clients"].values():
+            assert cl["dps"] > 0 and cl["p99_ms"] > 0
+            assert cl["identical"] is True
+            assert sum(cl["commit_results"].values()) == ca["probes"]
+            assert cl["conflicts"] >= 0
+        assert ca["identical"] is True, "concurrent decisions diverged from serial"
+        assert ca["p99_ms"] == ca["clients"]["8"]["p99_ms"]
+        assert ca["dps_8clients"] == ca["clients"]["8"]["dps"]
+        assert ca["speedup_8clients"] > 0
+        assert ca["lock_hold_ms_p95"] >= 0
+        sec = artifact["secondary_configs"]
+        assert sec["concurrent_admission_identical"] is True
+        assert sec["concurrent_admission_speedup_8"] == ca["speedup_8clients"]
     else:
         assert headline["metric"].startswith("p99_queue_solve")
         assert lane is None

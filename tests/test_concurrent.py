@@ -1,0 +1,373 @@
+"""Concurrent admission engine tests (ISSUE 18).
+
+The property test is the tentpole's contract: the speculate→FIFO-commit
+engine, fanned across 2/4/8 client threads, produces **byte-identical**
+decisions to the serial extender over seeded random workloads, for every
+tpu-batch assignment policy — same granted nodes, same FailedNodes
+messages, pod for pod, in ticket order.  The unit half pins the
+CommitGate's linearizable FIFO semantics (aborts skip ahead, waiters
+wake exactly at head), the multi-active stale-epoch refusal, and the
+AdmissionGate shed path's audit trail (provenance record + lifecycle
+``shed`` phase + revival on retry).
+"""
+
+import threading
+import types
+
+import pytest
+
+from k8s_spark_scheduler_tpu.concurrent import (
+    CommitGate,
+    ConcurrentAdmissionEngine,
+)
+from k8s_spark_scheduler_tpu.config import (
+    ConcurrentConfig,
+    FifoConfig,
+    Install,
+    ResilienceConfig,
+)
+from k8s_spark_scheduler_tpu.ha.fencing import StaleEpochError
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+from k8s_spark_scheduler_tpu.types.extenderapi import ExtenderArgs
+from k8s_spark_scheduler_tpu.types.objects import Pod, PodPhase
+
+
+def _install(policy: str, concurrent: bool = False, **conc_overrides) -> Install:
+    """An Install identical to the default Harness wiring except for the
+    binpack policy and the concurrent block — the property test depends
+    on everything else matching the serial install exactly."""
+    kwargs = {}
+    if concurrent:
+        kwargs["concurrent"] = ConcurrentConfig(enabled=True, **conc_overrides)
+    return Install(
+        fifo=True,
+        fifo_config=FifoConfig(),
+        binpack_algo=policy,
+        **kwargs,
+    )
+
+
+# -- the seeded workload (test_policy.py's idiom: varied sizes so some
+#    apps fit, some hit failure-fit, refused ones gate later drivers) ---
+
+
+def _seeded_workload(seed: int):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    nodes = [
+        (f"n{i}", str(int(rng.randint(4, 9))), f"{int(rng.randint(4, 9))}Gi")
+        for i in range(3)
+    ]
+    apps = [
+        (
+            f"app-{seed}-{i}",
+            int(rng.randint(0, 4)),
+            str(int(rng.randint(1, 3))),
+        )
+        for i in range(6)
+    ]
+    return nodes, apps
+
+
+def _build_cluster(h: Harness, seed: int):
+    """Create nodes + every pod up front (creation timestamps fix the
+    FIFO queue order; ``_earlier_drivers`` filters by timestamp, so the
+    upfront creation is visible identically to both runs) and return
+    the flat scheduling order: [driver, execs..] per app, app by app."""
+    nodes, apps = _seeded_workload(seed)
+    for name, cpu, mem in nodes:
+        h.new_node(name, cpu=cpu, memory=mem)
+    node_names = [n[0] for n in nodes]
+    flat = []
+    for i, (app_id, executor_count, executor_cpu) in enumerate(apps):
+        pods = h.static_allocation_spark_pods(
+            app_id,
+            executor_count,
+            executor_cpu=executor_cpu,
+            creation_timestamp=1000.0 + i,
+        )
+        for pod in pods:
+            h.create_pod(pod)
+            flat.append(pod)
+    return flat, node_names
+
+
+def _decision(pod, result):
+    return (
+        pod.name,
+        tuple(result.node_names or ()),
+        tuple(sorted((result.failed_nodes or {}).items())),
+    )
+
+
+def _run_serial(policy: str, seed: int):
+    h = Harness(extra_install=_install(policy))
+    try:
+        assert h.server.concurrent is None
+        flat, node_names = _build_cluster(h, seed)
+        return [_decision(p, h.schedule(p, node_names)) for p in flat]
+    finally:
+        h.close()
+
+
+def _run_concurrent(policy: str, seed: int, n_threads: int):
+    h = Harness(extra_install=_install(policy, concurrent=True))
+    try:
+        engine = h.server.concurrent
+        assert engine is not None
+        flat, node_names = _build_cluster(h, seed)
+        # tickets preassigned in workload order: the FIFO commit order is
+        # the serial schedule order regardless of thread interleaving
+        tickets = [engine.gate.ticket() for _ in flat]
+        decisions = [None] * len(flat)
+        errors = []
+
+        def bind(result, pod):
+            # the deterministic stand-in for the kube bind that follows
+            # a granted Filter (harness.schedule does the same), run
+            # inside the commit turn so the next commit sees it — the
+            # watch fan-out is synchronous on this thread
+            if result.node_names:
+                bound = h.api.get(Pod.KIND, pod.namespace, pod.name)
+                bound.node_name = result.node_names[0]
+                bound.phase = PodPhase.RUNNING
+                h.api.update(bound)
+
+        def worker(idx: int):
+            try:
+                # each thread owns every (n_threads)-th request, in
+                # increasing ticket order — no cyclic waits
+                for j in range(idx, len(flat), n_threads):
+                    pod = h.server.pod_informer.get(
+                        flat[j].namespace, flat[j].name
+                    ).deepcopy()
+                    args = ExtenderArgs(pod=pod, node_names=list(node_names))
+                    result = engine.predicate(
+                        args,
+                        ticket=tickets[j],
+                        post_commit=lambda r, p=pod: bind(r, p),
+                    )
+                    decisions[j] = _decision(pod, result)
+            except BaseException as err:  # noqa: BLE001 - surfaced below
+                errors.append((idx, err))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        assert all(d is not None for d in decisions)
+        return decisions, engine.stats(), h.server.metrics.snapshot()
+    finally:
+        h.close()
+
+
+# seed × client-thread-count × assignment policy: every thread count and
+# every tpu-batch policy appears, across the 5 seeds
+CASES = [
+    (11, 2, "tpu-batch"),
+    (23, 4, "tpu-batch-distribute-evenly"),
+    (37, 8, "tpu-batch-minimal-fragmentation"),
+    (41, 4, "tpu-batch"),
+    (59, 8, "tpu-batch-distribute-evenly"),
+]
+
+
+@pytest.mark.parametrize("seed,n_threads,policy", CASES)
+def test_concurrent_engine_is_decision_identical_to_serial(seed, n_threads, policy):
+    baseline = _run_serial(policy, seed)
+    decisions, stats, snapshot = _run_concurrent(policy, seed, n_threads)
+    assert decisions == baseline
+    # every request committed through the gate, none aborted, and the
+    # head drained to the ticket count (no stuck turns)
+    gate = stats["gate"]
+    assert gate["committed"] == len(baseline)
+    assert gate["aborted"] == 0
+    assert gate["head"] == gate["issued"] == len(baseline)
+    assert sum(stats["commit_results"].values()) == len(baseline)
+    # speculation engaged: tpu-batch wires the tensor mirror, so driver
+    # requests produce verdicts — and at least the uncontended ones
+    # survive revalidation as seq/memcmp hits
+    counters = snapshot["counters"]
+    solved = sum(
+        v
+        for k, v in counters.items()
+        if "concurrent.speculation.count" in k and "outcome=solved" in k
+    )
+    assert solved > 0, f"speculation never engaged: {stats['commit_results']}"
+    hits = stats["commit_results"].get("seq-hit", 0) + stats[
+        "commit_results"
+    ].get("memcmp-hit", 0)
+    assert hits > 0, stats["commit_results"]
+
+
+def test_disabled_config_wires_no_engine():
+    h = Harness(extra_install=_install("tpu-batch"))
+    try:
+        assert h.server.concurrent is None
+    finally:
+        h.close()
+
+
+# -- CommitGate: linearizable FIFO turn-taking --------------------------
+
+
+def test_gate_tickets_are_fifo_and_head_turn_returns_immediately():
+    gate = CommitGate()
+    assert [gate.ticket() for _ in range(3)] == [0, 1, 2]
+    gate.await_turn(0)  # head: no parking
+    gate.retire(0, committed=True)
+    assert gate.head() == 1
+    s = gate.stats()
+    assert s["committed"] == 1 and s["aborted"] == 0
+    assert s["max_queue_depth"] == 3
+
+
+def test_gate_parks_until_every_earlier_ticket_retires():
+    gate = CommitGate()
+    t0, t1 = gate.ticket(), gate.ticket()
+    entered = threading.Event()
+    done = threading.Event()
+
+    def late():
+        entered.set()
+        gate.await_turn(t1)
+        done.set()
+
+    th = threading.Thread(target=late, daemon=True)
+    th.start()
+    assert entered.wait(5)
+    assert not done.wait(0.1), "ticket 1 committed before ticket 0 retired"
+    gate.retire(t0, committed=True)
+    assert done.wait(5), "head advance never woke the parked waiter"
+    gate.retire(t1, committed=True)
+    th.join(5)
+    assert gate.depth() == 0
+
+
+def test_gate_aborts_skip_ahead_without_stalling_fifo():
+    gate = CommitGate()
+    t0, t1, t2 = gate.ticket(), gate.ticket(), gate.ticket()
+    # ticket 1 aborts out of order (deadline expiry before its turn)
+    gate.retire(t1, committed=False)
+    assert gate.head() == t0
+    gate.retire(t0, committed=True)
+    # the head skipped the aborted ticket: 2 commits next, immediately
+    assert gate.head() == t2
+    gate.await_turn(t2)
+    gate.retire(t2, committed=True)
+    s = gate.stats()
+    assert s["committed"] == 2 and s["aborted"] == 1
+    assert s["head"] == s["issued"] == 3
+
+
+# -- multi-active: epoch-fenced commit intents --------------------------
+
+
+def test_stale_epoch_intent_is_refused_before_the_gate():
+    h = Harness(extra_install=_install("tpu-batch"))
+    try:
+        h.new_node("n1", cpu="8", memory="8Gi")
+        epoch = [1]
+        engine = ConcurrentAdmissionEngine(
+            h.extender,
+            ConcurrentConfig(enabled=True),
+            metrics=h.server.metrics,
+            epoch_source=lambda: epoch[0],
+        )
+        pods = h.static_allocation_spark_pods("app-intent", 0)
+        h.create_pod(pods[0])
+        args = ExtenderArgs(pod=pods[0], node_names=["n1"])
+        intent = engine.make_intent(args, origin="replica-b")
+        assert intent.epoch == 1
+        assert intent.pod_name == pods[0].name
+
+        # leadership moved: the forwarded intent must be refused before
+        # it ever reaches the commit gate (I-H3 at the intent layer)
+        epoch[0] = 2
+        with pytest.raises(StaleEpochError):
+            engine.submit_intent(intent)
+        counters = h.server.metrics.snapshot()["counters"]
+        stale = sum(
+            v
+            for k, v in counters.items()
+            if "concurrent.intents.forwarded" in k and "stale-epoch" in k
+        )
+        assert stale == 1
+        # no commit happened: the gate saw only the make_intent ticket
+        assert engine.gate.stats()["committed"] == 0
+
+        # a fresh intent under the current epoch commits normally and
+        # grants the node
+        fresh = engine.make_intent(args, origin="replica-b")
+        assert fresh.epoch == 2
+        result = engine.submit_intent(fresh)
+        assert result.node_names == ["n1"]
+        committed = sum(
+            v
+            for k, v in h.server.metrics.snapshot()["counters"].items()
+            if "concurrent.intents.forwarded" in k and "result=committed" in k
+        )
+        assert committed == 1
+    finally:
+        h.close()
+
+
+# -- AdmissionGate shed: terminal phase + provenance + revival ----------
+
+
+def test_shed_leaves_audit_trail_and_revives_on_retry():
+    """A shed Filter must leave the same audit trail a refusal does:
+    a provenance DecisionRecord (``/explain`` answers for sheds too), a
+    lifecycle ``shed`` phase, and pod/namespace/outcome tags on the
+    trace span — then kube-scheduler's retry revives the gang out of
+    ``shed`` into the live phases."""
+    from k8s_spark_scheduler_tpu.server.http import _Handler
+
+    install = Install(
+        fifo=True,
+        fifo_config=FifoConfig(),
+        binpack_algo="tightly-pack",
+        resilience=ResilienceConfig(admission_max_waiters=1),
+    )
+    h = Harness(extra_install=install)
+    try:
+        h.new_node("n1", cpu="8", memory="8Gi")
+        pods = h.static_allocation_spark_pods("app-shed", 0)
+        driver = h.create_pod(pods[0])
+        args = ExtenderArgs(pod=driver, node_names=["n1"])
+        shim = types.SimpleNamespace(scheduler=h.server)
+        kit = h.server.resilience
+        with kit.gate.admit():  # occupy the only admission slot
+            result = _Handler._predicate_guarded(shim, args)
+        assert not result.node_names
+        assert set(result.failed_nodes) == {"n1"}
+        assert "overloaded" in result.failed_nodes["n1"]
+
+        # provenance: the shed is explainable by pod name
+        rec = h.server.provenance.explain(driver.name, source="test")
+        assert rec is not None
+        assert rec["outcome"] == "shed"
+        assert rec["namespace"] == "default"
+
+        # lifecycle: the gang carries the terminal-for-the-attempt phase
+        gang = h.server.lifecycle.record("app-shed")
+        assert gang is not None and gang["phase"] == "shed"
+
+        # the retry (gate slot free now) admits and revives the record
+        retry = _Handler._predicate_guarded(shim, args)
+        assert retry.node_names == ["n1"]
+        deadline = threading.Event()
+        for _ in range(100):
+            gang = h.server.lifecycle.record("app-shed")
+            if gang["phase"] != "shed":
+                break
+            deadline.wait(0.05)
+        assert gang["phase"] != "shed", gang
+    finally:
+        h.close()

@@ -5,9 +5,10 @@ BaseException severity, disabled-path shape); the integration half runs
 representative crash-matrix cells through the real server stack: kill
 -9 at the armed point, cold-restart a successor on the same API server
 and journal files, audit invariants + exactly-once intent delivery.
-The full 10-point sweep runs in CI (ha-crash-matrix job); the subset
+The full 13-point sweep runs in CI (ha-crash-matrix job); the subset
 here covers one point per pipeline — write-back, journal divert/ack,
-whole-gang preemption, lease renewal.
+whole-gang preemption, lease renewal, and the concurrent admission
+engine's speculation→commit window.
 """
 
 import pytest
@@ -35,8 +36,8 @@ def _disarmed():
 
 def test_registry_covers_every_pipeline():
     points = crashpoint.registered_points()
-    assert len(points) == 10
-    for prefix in ("writeback.", "journal.", "preempt.", "lease."):
+    assert len(points) == 13
+    for prefix in ("writeback.", "journal.", "preempt.", "lease.", "concurrent."):
         assert any(p.startswith(prefix) for p in points), prefix
 
 
@@ -76,13 +77,22 @@ def test_simulated_crash_skips_except_exception():
 
 # -- matrix cells through the real server stack ------------------------------
 
-# one representative point per pipeline; CI sweeps all ten
+# one representative point per pipeline; CI sweeps all thirteen
 SUBSET = [
     crashpoint.WRITEBACK_PRE_COMMIT,
     crashpoint.JOURNAL_POST_APPEND,
     crashpoint.JOURNAL_POST_ACK,
     crashpoint.PREEMPT_MID_EXECUTE,
     crashpoint.LEASE_PRE_RENEW,
+]
+
+# the speculation→commit window (concurrent/engine.py): every cell, not
+# a representative — exactly-once reservation state across the restart
+# is this PR's proof burden
+CONCURRENT_WINDOW = [
+    crashpoint.CONCURRENT_SPECULATION_SOLVED,
+    crashpoint.CONCURRENT_COMMIT_REVALIDATED,
+    crashpoint.CONCURRENT_COMMIT_WRITTEN,
 ]
 
 
@@ -97,6 +107,24 @@ def test_crash_point_recovery(point):
     assert report["journalDepth"] == 0
     assert report["evictJournalDepth"] == 0
     assert report["staleCommits"] == 0
+
+
+@pytest.mark.parametrize("point", CONCURRENT_WINDOW)
+def test_concurrent_window_crash_is_exactly_once(point):
+    """Death inside the speculation→commit window: a crash before the
+    commit leaves ZERO reservation state (the gang was never admitted;
+    kube-scheduler's retry re-admits from scratch); a crash after the
+    reservation write leaves all-or-nothing, never a half-committed
+    gang.  Cold restart replays journals to exactly-once either way."""
+    report = CrashMatrix(nodes=2).run_point(point)
+    assert report["crashed"], f"{point}: crash never fired"
+    assert report["ok"], f"{point}: {report['violations']}"
+    assert report["recoveredEpoch"] == 2
+    assert report["journalDepth"] == 0
+    assert report["staleCommits"] == 0
+    if point != crashpoint.CONCURRENT_COMMIT_WRITTEN:
+        # pre-commit deaths must be invisible: no reservation at all
+        assert report["reservationPresent"] is False
 
 
 def test_mid_preemption_crash_finishes_the_eviction():
