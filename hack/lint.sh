@@ -15,6 +15,17 @@ echo "==> schedlint native-boundary + lock-coverage audit (--select LK004,NA --s
 # Python↔C++ boundary regression should say so, not "lint failed"
 python -m k8s_spark_scheduler_tpu.analysis --strict --select LK004,NA || rc=1
 
+echo "==> schedlint protocol verifier (--select PC --strict: tickets, fencing, journal, spans, deadlines)"
+# also covered by the full run; named so a typestate regression reads
+# as "protocol discipline broken", not generic lint noise
+python -m k8s_spark_scheduler_tpu.analysis --strict --select PC || rc=1
+
+echo "==> schedlint suppression baseline (no new pragmas/allowlist entries)"
+# zero findings is only meaningful if nothing new was silenced; a
+# justified new suppression regenerates the baseline in the same PR
+# (python tools/schedlint_diff.py --write-baseline)
+python tools/schedlint_diff.py --diff-baseline || rc=1
+
 echo "==> native build (native/*.cpp compile + load, incl. the delta-solve session)"
 python - <<'PY' || rc=1
 from k8s_spark_scheduler_tpu.native import native_available

@@ -6,10 +6,14 @@
 #
 #   hack/sanitize.sh tsan   # -fsanitize=thread (SweepPool / session churn)
 #   hack/sanitize.sh asan   # -fsanitize=address,undefined (full API walk)
-#   hack/sanitize.sh        # both
+#   hack/sanitize.sh tidy   # clang-tidy bugprone-*/concurrency-* static pass
+#   hack/sanitize.sh        # all of the above
 #
-# Suppressions live in native/tests/tsan.supp — empty by policy unless
-# every entry is justified (see the header there).
+# Suppressions live in native/tests/tsan.supp (dynamic lanes) and
+# native/tests/clang-tidy.supp (static lane) — both empty by policy
+# unless every entry is justified (see the headers there).  The tidy
+# lane is skipped with a notice when clang-tidy is not installed, so
+# `hack/sanitize.sh` stays runnable on a bare toolchain; CI installs it.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -36,10 +40,36 @@ run_asan() {
     ASAN_OPTIONS="detect_leaks=1" ./native/_build/smoke_asan
 }
 
+run_tidy() {
+    if ! command -v clang-tidy >/dev/null 2>&1; then
+        echo "==> clang-tidy not installed — skipping static lane (apt install clang-tidy)"
+        return 0
+    fi
+    echo "==> clang-tidy (bugprone-*, concurrency-* over native/*.cpp)"
+    # no compile_commands.json in this build (the extension is compiled
+    # ad hoc by the ctypes loader), so pass the flags after --
+    local out rc=0
+    out="$(clang-tidy --quiet $SRCS -- $COMMON 2>/dev/null)" || rc=$?
+    # filter diagnostics through the justified-suppression file; any
+    # remaining warning fails the lane
+    local remaining
+    remaining="$(printf '%s\n' "$out" | grep -E 'warning:|error:' | \
+        grep -v -F -f <(grep -vE '^\s*(#|$)' native/tests/clang-tidy.supp; echo '\x01never-matches') \
+        || true)"
+    if [ -n "$remaining" ]; then
+        printf '%s\n' "$out"
+        echo "clang-tidy: unsuppressed diagnostics (justify in native/tests/clang-tidy.supp or fix):" >&2
+        printf '%s\n' "$remaining" >&2
+        return 1
+    fi
+    echo "clang-tidy: clean"
+}
+
 case "${1:-all}" in
     tsan) run_tsan ;;
     asan) run_asan ;;
-    all)  run_tsan; run_asan ;;
-    *) echo "usage: hack/sanitize.sh [tsan|asan|all]" >&2; exit 2 ;;
+    tidy) run_tidy ;;
+    all)  run_tsan; run_asan; run_tidy ;;
+    *) echo "usage: hack/sanitize.sh [tsan|asan|tidy|all]" >&2; exit 2 ;;
 esac
 echo "sanitize: clean"

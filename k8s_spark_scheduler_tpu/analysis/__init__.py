@@ -16,6 +16,12 @@ ordinary linters cannot see:
   traced values, concretize tracers, or close over mutable state: each
   of those is a silent-retrace (or outright crash) hazard on the
   binpack hot path.
+- **PC protocol discipline** — flow-sensitive typestate over a real CFG
+  (:mod:`.flow`): commit-gate tickets retire on *every* path including
+  exceptional ones, kube mutations are dominated by a fencing check
+  from their entry points, journal intents are never acked before their
+  execute, spans/locks close path-completely, and the extender's phase
+  ladder re-arms its deadline at each boundary (:mod:`.rules_protocol`).
 
 Run it::
 
@@ -34,23 +40,31 @@ from __future__ import annotations
 from .core import (
     DEFAULT_ALLOWLIST,
     AnalysisConfig,
+    AnalysisResult,
     Finding,
+    SuppressedFinding,
     analyze_package,
     analyze_paths,
+    analyze_paths_detailed,
     load_allowlist,
+    package_root,
 )
 from .guarded import guarded_by, guarded_fields
 from .reporters import render_json, render_text
 
 __all__ = [
     "AnalysisConfig",
+    "AnalysisResult",
     "DEFAULT_ALLOWLIST",
     "Finding",
+    "SuppressedFinding",
     "analyze_package",
     "analyze_paths",
+    "analyze_paths_detailed",
     "guarded_by",
     "guarded_fields",
     "load_allowlist",
+    "package_root",
     "render_json",
     "render_text",
 ]

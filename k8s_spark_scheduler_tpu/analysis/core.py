@@ -206,15 +206,43 @@ def package_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def analyze_paths(
+@dataclass(frozen=True)
+class SuppressedFinding:
+    """A finding that a pragma or allowlist entry silenced — kept so
+    tooling (``tools/schedlint_diff.py``) can tell pre-existing
+    justified suppressions apart from *new* ones."""
+
+    finding: Finding
+    via: str             # "pragma" | "allowlist"
+    why: str
+
+    def to_dict(self) -> dict:
+        d = self.finding.to_dict()
+        d["suppressed_via"] = self.via
+        d["why"] = self.why
+        return d
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]
+    suppressed: List[SuppressedFinding]
+
+
+def analyze_paths_detailed(
     paths: Sequence[str],
     config: Optional[AnalysisConfig] = None,
     root: Optional[str] = None,
-) -> List[Finding]:
+) -> AnalysisResult:
     """Analyze the given files/directories.  ``root`` anchors the
     package-relative paths used by pragmas/allowlists (defaults to the
-    installed package directory)."""
-    from . import rules_jax, rules_locks, rules_native, rules_time
+    installed package directory).
+
+    Two passes: the per-file rule modules run on each file as it is
+    parsed, then the protocol rules (:mod:`.rules_protocol`) run once
+    over the whole file set — PC003's fence-dominance is
+    interprocedural, so it needs every function in scope at once."""
+    from . import rules_jax, rules_locks, rules_native, rules_protocol, rules_time
 
     config = config or AnalysisConfig()
     root = os.path.abspath(root or package_root())
@@ -229,6 +257,9 @@ def analyze_paths(
             files.append(p)
 
     findings: List[Finding] = []
+    suppressed: List[SuppressedFinding] = []
+    contexts: List[FileContext] = []
+    raw: List[Finding] = []
     for path in files:
         relpath = os.path.relpath(path, root).replace(os.sep, "/")
         with open(path, "r", encoding="utf-8") as f:
@@ -248,20 +279,12 @@ def analyze_paths(
             )
             continue
         ctx = FileContext(relpath, source, tree)
-        raw: List[Finding] = []
+        contexts.append(ctx)
         raw.extend(rules_time.check(ctx))
         raw.extend(rules_locks.check(ctx))
         raw.extend(rules_jax.check(ctx))
         raw.extend(rules_native.check(ctx))
-
-        for finding in raw:
-            if not config.rule_selected(finding.rule):
-                continue
-            if allowlisted(allowlist, finding.rule, relpath):
-                continue
-            if ctx.pragma_for(finding.rule, finding.line) is not None:
-                continue
-            findings.append(finding)
+        raw.extend(rules_protocol.check(ctx))
 
         if config.strict:
             # every pragma in the file — used or not — must carry a
@@ -284,8 +307,45 @@ def analyze_paths(
                         )
                     )
 
+    # package-wide pass (interprocedural rules)
+    raw.extend(rules_protocol.check_package(contexts))
+
+    ctx_by_relpath = {c.relpath: c for c in contexts}
+    for finding in raw:
+        if not config.rule_selected(finding.rule):
+            continue
+        if allowlisted(allowlist, finding.rule, finding.file):
+            for entry in allowlist.get(finding.rule, ()):
+                prefix = entry["path"]
+                if finding.file == prefix or finding.file.startswith(
+                    prefix.rstrip("/") + "/"
+                ) or (prefix.endswith("/") and finding.file.startswith(prefix)):
+                    suppressed.append(
+                        SuppressedFinding(finding, "allowlist", str(entry.get("why", "")))
+                    )
+                    break
+            continue
+        ctx = ctx_by_relpath.get(finding.file)
+        pragma = ctx.pragma_for(finding.rule, finding.line) if ctx else None
+        if pragma is not None:
+            suppressed.append(
+                SuppressedFinding(finding, "pragma", pragma.why or "")
+            )
+            continue
+        findings.append(finding)
+
     findings.sort(key=Finding.sort_key)
-    return findings
+    suppressed.sort(key=lambda s: s.finding.sort_key())
+    return AnalysisResult(findings=findings, suppressed=suppressed)
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    config: Optional[AnalysisConfig] = None,
+    root: Optional[str] = None,
+) -> List[Finding]:
+    """Backward-compatible wrapper: just the surviving findings."""
+    return analyze_paths_detailed(paths, config=config, root=root).findings
 
 
 def analyze_package(config: Optional[AnalysisConfig] = None) -> List[Finding]:

@@ -812,31 +812,34 @@ def _concurrent_commit_scenario() -> Scenario:
     def threads(st: State):
         def request(abort: bool, mutates: bool):
             ticket = st.gate.ticket()
-            # the speculative solve: an off-lock snapshot read of the
-            # basis, concurrent with every other request's
-            with st._lock:
-                racecheck.note_access(st, "basis_seq")
-                spec_seq = st.basis_seq
-            checkpoint("speculated")
-            if abort:
-                # deadline expired before the turn: retire without
-                # committing — later tickets must skip over this one
+            committed = False
+            try:
+                # the speculative solve: an off-lock snapshot read of
+                # the basis, concurrent with every other request's
                 with st._lock:
-                    racecheck.note_access(st, "aborted")
-                    st.aborted.append(ticket)
-                st.gate.retire(ticket, False)
-                return
-            st.gate.await_turn(ticket)
-            # the commit: revalidate the speculation against the
-            # then-current basis — O(1) seq check, conflict → re-solve
-            with st._lock:
-                racecheck.note_access(st, "basis_seq")
-                racecheck.note_access(st, "commit_log")
-                reason = "seq-hit" if st.basis_seq == spec_seq else "conflict"
-                st.commit_log.append((ticket, reason))
-                if mutates:
-                    st.basis_seq += 1
-            st.gate.retire(ticket, True)
+                    racecheck.note_access(st, "basis_seq")
+                    spec_seq = st.basis_seq
+                checkpoint("speculated")
+                if abort:
+                    # deadline expired before the turn: retire without
+                    # committing — later tickets must skip this one
+                    with st._lock:
+                        racecheck.note_access(st, "aborted")
+                        st.aborted.append(ticket)
+                    return
+                st.gate.await_turn(ticket)
+                # the commit: revalidate the speculation against the
+                # then-current basis — O(1) seq check, conflict → re-solve
+                with st._lock:
+                    racecheck.note_access(st, "basis_seq")
+                    racecheck.note_access(st, "commit_log")
+                    reason = "seq-hit" if st.basis_seq == spec_seq else "conflict"
+                    st.commit_log.append((ticket, reason))
+                    if mutates:
+                        st.basis_seq += 1
+                committed = True
+            finally:
+                st.gate.retire(ticket, committed)
 
         return [
             ("commit-a", lambda: request(False, True)),

@@ -13,16 +13,27 @@ are never renamed, only added (bump ``schema_version`` when they are).
         {"rule": "TS001", "category": "determinism", "file": "tracing/spans.py",
          "line": 118, "col": 8, "message": "...", "symbol": "Span.__enter__"}
       ],
-      "counts": {"total": 1, "by_rule": {"TS001": 1}, "by_category": {"determinism": 1}}
+      "counts": {"total": 1, "by_rule": {"TS001": 1}, "by_category": {"determinism": 1}},
+      "suppressed": [
+        {"rule": "TS002", "file": "util/locktime.py", "line": 40, "col": 8,
+         "category": "determinism", "message": "...", "symbol": "...",
+         "suppressed_via": "allowlist", "why": "monotonic deadline arithmetic"}
+      ]
     }
+
+``suppressed`` (added, schema unchanged: keys are only ever added) lists
+every finding that an allowlist entry or pragma silenced, with the
+justification.  CI diffs it against a committed baseline so a *new*
+suppression — someone pragma-ing their way past a fresh finding — fails
+review even though the findings list stays empty.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List
+from typing import Dict, List, Optional
 
-from .core import Finding
+from .core import Finding, SuppressedFinding
 
 SCHEMA_VERSION = 1
 
@@ -41,7 +52,11 @@ def render_text(findings: List[Finding]) -> str:
     return "\n".join(lines) + "\n"
 
 
-def render_json(findings: List[Finding], strict: bool = False) -> str:
+def render_json(
+    findings: List[Finding],
+    strict: bool = False,
+    suppressed: Optional[List[SuppressedFinding]] = None,
+) -> str:
     doc = {
         "schema_version": SCHEMA_VERSION,
         "tool": "schedlint",
@@ -52,6 +67,7 @@ def render_json(findings: List[Finding], strict: bool = False) -> str:
             "by_rule": _count_by(findings, "rule"),
             "by_category": _count_by(findings, "category"),
         },
+        "suppressed": [s.to_dict() for s in (suppressed or [])],
     }
     return json.dumps(doc, indent=2, sort_keys=True) + "\n"
 

@@ -155,8 +155,12 @@ class ConcurrentAdmissionEngine:
                 post_commit(result)
             return result
         finally:
-            self.speculator.finish(ticket)
-            self.gate.retire(ticket, committed)
+            # retire must be unskippable: if finish() ever raised, a
+            # skipped retire would stall the FIFO line forever
+            try:
+                self.speculator.finish(ticket)
+            finally:
+                self.gate.retire(ticket, committed)
 
     def _commit(self, args, verdict):
         """Execute the serial extender under this ticket's turn, with
@@ -203,8 +207,10 @@ class ConcurrentAdmissionEngine:
                 else None
             )
         finally:
-            self.speculator.finish(ticket)
-            self.gate.retire(ticket, False)
+            try:
+                self.speculator.finish(ticket)
+            finally:
+                self.gate.retire(ticket, False)
         epoch = self._epoch_source() if self._epoch_source is not None else 0
         return CommitIntent(
             pod_name=args.pod.name,

@@ -148,6 +148,14 @@ class PreemptionCoordinator:
         """Replay pending evict intents (crash between journal and
         ack).  Called at wiring boot on the active AND by the standby
         after takeover; idempotent execution + ack = exactly-once."""
+        gate = self.fence_gate
+        if gate is not None:
+            # replay executes deletes: a deposed replica must not
+            # re-drive evictions the successor may have superseded.
+            # Boot-time recover runs before the fence is installed
+            # (gate is None); post-takeover recover runs after the
+            # lease grant, so a live leader always passes.
+            gate.check("preempt.recover")
         replayed = 0
         for intent in self._journal.pending():
             if intent.get("kind") != EVICT_KIND or intent.get("op") != "delete":

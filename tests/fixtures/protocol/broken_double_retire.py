@@ -1,0 +1,15 @@
+"""Broken twin of the commit-fifo scenario's request(): the abort path
+retires inside the try AND again in the finally — the second retire
+releases someone else's commit turn.  PC002 fixture."""
+
+
+class BrokenRequest:
+    def request(self, st, abort):
+        ticket = st.gate.ticket()
+        try:
+            if abort:
+                st.gate.retire(ticket, False)
+                return
+            st.gate.await_turn(ticket)
+        finally:
+            st.gate.retire(ticket, True)

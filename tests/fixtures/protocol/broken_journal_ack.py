@@ -1,0 +1,13 @@
+"""Broken twin of the write-back worker: ``finally: ack`` acknowledges
+the intent even when the kube write raised — the intent is lost AND the
+write never happened (breaks the I-P4/J1 exactly-once contract).
+PC004 fixture."""
+
+
+class BrokenWorker:
+    def run_one(self, r):
+        self._journal.record("create", r.kind, r.ns, r.name, r.obj)
+        try:
+            self._client.create(r.kind, r.ns, r.obj)
+        finally:
+            self._journal.ack("create", r.ns, r.name)

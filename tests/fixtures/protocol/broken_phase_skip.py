@@ -1,0 +1,16 @@
+"""Broken twin of the extender's phase ladder: the binpack boundary is
+crossed without re-arming the deadline check — an expired request burns
+the solver's budget before failing.  PC006 fixture."""
+
+
+class BrokenExtender:
+    def select(self, ctx):
+        self._check_deadline("fifo-gate")
+        fitted = self._try_device_fifo(ctx)
+        if fitted is None:
+            fitted = self._fit_earlier_drivers(ctx)
+        with self._tracer.span("binpack"):
+            plan = self.binpacker.binpack(ctx)
+        self._check_deadline("reservation-writeback")
+        self._rrm.create_reservations(plan)
+        return plan

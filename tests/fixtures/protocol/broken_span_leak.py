@@ -1,0 +1,20 @@
+"""Broken twin of a handler that opens a span and a lock manually:
+early returns skip the close.  PC005 fixture."""
+
+
+class BrokenHandler:
+    def handle(self, req):
+        span = self._tracer.span("request")
+        span.__enter__()
+        if req.bad:
+            return None
+        result = self._process(req)
+        span.__exit__(None, None, None)
+        return result
+
+    def try_lock(self):
+        self._stats_lock.acquire()
+        if self._busy:
+            return False
+        self._stats_lock.release()
+        return True

@@ -1,0 +1,17 @@
+"""Broken twin of ConcurrentAdmissionEngine.predicate (pre-PR19 shape):
+``finish`` raising inside the finally skips the retire, leaking the
+FIFO ticket and stalling the commit line forever.  PC001 fixture."""
+
+
+class BrokenPredicate:
+    def predicate(self, args):
+        ticket = self.gate.ticket()
+        committed = False
+        try:
+            verdict = self.speculator.speculate(ticket, args)
+            result = self.commit(args, verdict)
+            committed = True
+            return result
+        finally:
+            self.speculator.finish(ticket)
+            self.gate.retire(ticket, committed)

@@ -1,0 +1,20 @@
+"""Broken twin of PreemptionCoordinator.recover (pre-PR19): replaying
+evict intents executes pod deletes with no fence check — a deposed
+replica could still write.  PC003 fixture."""
+# schedlint: entrypoints=BrokenCoordinator.recover
+
+
+class BrokenCoordinator:
+    def commit(self, plan):
+        gate = self.fence_gate
+        if gate is not None:
+            gate.check("preempt.commit")
+        for victim in plan.victims:
+            self._execute(victim.ns, victim.app_id)
+
+    def _execute(self, ns, app_id):
+        self._api.delete("Pod", ns, app_id)
+
+    def recover(self):
+        for intent in self._journal.pending():
+            self._execute(intent["ns"], intent["name"])

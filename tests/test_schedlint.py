@@ -7,6 +7,7 @@ from this test's first green run onwards.
 """
 
 import json
+import os
 
 import pytest
 
@@ -44,8 +45,29 @@ def test_cli_list_rules_covers_all_families(capsys):
     out = capsys.readouterr().out
     for rule in ("TS001", "TS002", "TS003", "DT001", "LK001", "LK002",
                  "LK003", "LK004", "JX001", "JX002", "JX003", "JX004",
-                 "NA001", "NA002", "PR001"):
+                 "NA001", "NA002", "PC001", "PC002", "PC003", "PC004",
+                 "PC005", "PC006", "PR001"):
         assert rule in out
+    # grouped by family: the family header precedes its rules
+    assert out.index("PC  ") < out.index("PC001")
+
+
+def test_cli_unknown_select_family_is_an_error(capsys):
+    # a typo must not silently select nothing and report "clean"
+    assert cli_main(["--select", "QZ"]) == 2
+    err = capsys.readouterr().err
+    assert "QZ" in err and "unknown" in err
+
+
+def test_cli_mixed_select_with_unknown_token_is_an_error(capsys):
+    assert cli_main(["--select", "TS,PCX01"]) == 2
+    assert "PCX01" in capsys.readouterr().err
+
+
+def test_cli_select_known_rule_prefixes_ok(capsys):
+    # exact rule ids and bare families both validate
+    assert cli_main(["--select", "PC003,LK", "--strict"]) == 0
+    assert "clean" in capsys.readouterr().out
 
 
 # -- pragma suppression -------------------------------------------------------
@@ -176,7 +198,11 @@ def test_merge_allowlists_concatenates_entries():
 def test_json_reporter_schema_stable_keys(tmp_path):
     findings = _analyze_snippet(tmp_path, BAD_TIME)
     doc = json.loads(render_json(findings, strict=True))
-    assert set(doc) == {"schema_version", "tool", "strict", "findings", "counts"}
+    # keys are only ever ADDED to this schema ("suppressed" rode in
+    # without a version bump); renames/removals bump schema_version
+    assert set(doc) == {
+        "schema_version", "tool", "strict", "findings", "counts", "suppressed",
+    }
     assert doc["schema_version"] == 1
     assert doc["tool"] == "schedlint"
     assert doc["strict"] is True
@@ -207,6 +233,90 @@ def test_findings_sorted_by_location(tmp_path):
     findings = _analyze_snippet(tmp_path, src)
     assert [f.rule for f in findings] == ["TS001", "DT001"]
     assert findings == sorted(findings, key=Finding.sort_key)
+
+
+# -- the suppressed channel + baseline gate -----------------------------------
+
+
+def test_suppressed_channel_records_pragma_with_why(tmp_path):
+    from k8s_spark_scheduler_tpu.analysis import analyze_paths_detailed
+
+    src = (
+        "import time\n\ndef stamp():\n"
+        "    return time.time()  # schedlint: disable=TS001 -- test clock\n"
+    )
+    f = tmp_path / "snippet.py"
+    f.write_text(src)
+    result = analyze_paths_detailed(
+        [str(f)],
+        config=AnalysisConfig(use_default_allowlist=False),
+        root=str(tmp_path),
+    )
+    assert result.findings == []
+    (s,) = result.suppressed
+    assert (s.finding.rule, s.via, s.why) == ("TS001", "pragma", "test clock")
+    doc = s.to_dict()
+    assert doc["suppressed_via"] == "pragma" and doc["why"] == "test clock"
+
+
+def _load_schedlint_diff():
+    import importlib.util
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "schedlint_diff", os.path.join(here, "tools", "schedlint_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_diff_baseline_flags_new_suppressions(tmp_path, monkeypatch, capsys):
+    mod = _load_schedlint_diff()
+    monkeypatch.setattr(
+        mod,
+        "current_suppressions",
+        lambda: [
+            {"rule": "TS001", "file": "a.py", "symbol": "f", "suppressed_via": "pragma"},
+        ],
+    )
+    empty = tmp_path / "baseline.json"
+    empty.write_text(json.dumps({"suppressions": []}))
+    assert mod.diff_baseline(str(empty)) == 1
+    out = capsys.readouterr().out
+    assert "NEW suppressions" in out and "TS001" in out
+
+
+def test_diff_baseline_accepts_committed_counts(tmp_path, monkeypatch):
+    mod = _load_schedlint_diff()
+    current = [
+        {"rule": "TS001", "file": "a.py", "symbol": "f", "suppressed_via": "pragma"},
+    ]
+    monkeypatch.setattr(mod, "current_suppressions", lambda: current)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(
+        json.dumps(
+            {
+                "suppressions": [
+                    {"rule": "TS001", "file": "a.py", "symbol": "f",
+                     "via": "pragma", "count": 1},
+                ]
+            }
+        )
+    )
+    assert mod.diff_baseline(str(baseline)) == 0
+    # line drift within the same (rule, file, symbol, via) key is free,
+    # but a SECOND suppression under that key is new again
+    monkeypatch.setattr(mod, "current_suppressions", lambda: current * 2)
+    assert mod.diff_baseline(str(baseline)) == 1
+
+
+def test_committed_suppression_baseline_is_current():
+    """The committed baseline must match the tree: a PR that adds a
+    pragma or allowlist entry regenerates it (--write-baseline) so the
+    new justification gets reviewed."""
+    mod = _load_schedlint_diff()
+    assert mod.diff_baseline(mod.DEFAULT_BASELINE) == 0
 
 
 # -- representative rule behavior --------------------------------------------
