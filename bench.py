@@ -712,6 +712,7 @@ def cpu_fallback() -> dict:
     _provenance_measure(problem)
     _capacity_probe_measure(problem)
     _preemption_whatif_measure(problem)
+    _class_compressed_measure()
 
     args = _device_args(problem)
 
@@ -1124,6 +1125,126 @@ def _preemption_whatif_measure(problem) -> None:
         )
     except Exception as err:
         print(f"# preemption-whatif lane unavailable: {err}", file=sys.stderr)
+
+
+def _class_compressed_measure() -> None:
+    """Equivalence-class lane (ROADMAP 2): the class-compressed native
+    solver at 100k nodes × 10k apps — the scale where per-app O(nodes)
+    row sweeps stop fitting in a Filter budget and O(classes + diverged
+    overlay) keeps working.  Runs at its OWN shape (``BENCH_CLASS_NODES``
+    × ``BENCH_CLASS_APPS``; 10× the main shape when unset so smoke runs
+    scale down honestly), proves byte-identical verdicts against a
+    row-level cold solve of the same inputs every run, and records the
+    compression evidence (class count, ratio, rebuilds) alongside the
+    latencies — the speedup claim is only as good as the parity + the
+    partition it rode on."""
+    try:
+        from k8s_spark_scheduler_tpu.native.fifo import (
+            NativeFifoSession,
+            native_classes_available,
+            solve_packed_classes,
+            solve_packed_cold,
+        )
+
+        if not native_classes_available():
+            return
+        cn = int(os.environ.get("BENCH_CLASS_NODES", str(N_NODES * 10)))
+        ca = int(os.environ.get("BENCH_CLASS_APPS", str(N_APPS * 10)))
+        rng = np.random.RandomState(20)
+        # fleet-shaped: ~24 machine shapes, salted with near-duplicates
+        # (one unit off) so the partition is earned, not gifted
+        shapes = rng.randint(20, 200, size=(24, 3)).astype(np.int32)
+        avail = shapes[rng.randint(0, 24, size=cn)].copy()
+        near = rng.choice(cn, size=max(1, cn // 50), replace=False)
+        avail[near, rng.randint(0, 3, size=len(near))] += 1
+        rank = np.arange(cn, dtype=np.int32)
+        rng.shuffle(rank)
+        eok = rng.rand(cn) > 0.05
+        drv = rng.randint(0, 3, size=(ca, 3)).astype(np.int32)
+        exe = rng.randint(1, 5, size=(ca, 3)).astype(np.int32)
+        cnt = rng.randint(1, 8, size=ca).astype(np.int32)
+        packed = np.hstack(
+            [drv, exe, cnt[:, None], np.ones((ca, 1), np.int32)]
+        ).astype(np.int32)
+
+        # parity first: the speedup only counts if the bits agree
+        feas, didx, after, evidence = solve_packed_classes(
+            0, avail, rank, eok, packed
+        )
+        ref_f, ref_d, ref_a = solve_packed_cold(0, avail, rank, eok, packed)
+        assert np.array_equal(feas, ref_f)
+        assert np.array_equal(didx, ref_d)
+        assert np.array_equal(after, ref_a)
+
+        # the row-level reference is seconds per solve at this shape:
+        # a few reps give a stable p50 without eating the bench budget
+        row_reps = max(3, min(ROUNDS, 5))
+        row_ms = []
+        for _ in range(row_reps):
+            t0 = time.perf_counter()
+            solve_packed_cold(0, avail, rank, eok, packed)
+            row_ms.append((time.perf_counter() - t0) * 1000.0)
+        cls_reps = max(ROUNDS, 10)
+        cold_ms = []
+        for _ in range(cls_reps):
+            t0 = time.perf_counter()
+            solve_packed_classes(0, avail, rank, eok, packed)
+            cold_ms.append((time.perf_counter() - t0) * 1000.0)
+
+        # warm lane: a persistent class-mode session resolving the same
+        # queue (full-prefix resume — the steady Filter retry path)
+        warm_ms = []
+        sess = NativeFifoSession()
+        try:
+            if sess.set_classes(True):
+                sess.load(avail, rank, eok, 0)
+                sess.solve(packed)
+                for _ in range(cls_reps):
+                    t0 = time.perf_counter()
+                    sess.solve(packed)
+                    warm_ms.append((time.perf_counter() - t0) * 1000.0)
+        finally:
+            sess.close()
+
+        row_lat, cold_lat = np.array(row_ms), np.array(cold_ms)
+        stats = _lane_stats(cold_lat, int(feas.sum()))
+        stats["nodes"] = cn
+        stats["apps"] = ca
+        stats["row_p50_ms"] = round(float(np.percentile(row_lat, 50)), 3)
+        stats["speedup_p50"] = round(
+            float(np.percentile(row_lat, 50))
+            / max(float(np.percentile(cold_lat, 50)), 1e-6),
+            1,
+        )
+        stats["classes_initial"] = int(evidence["classes_initial"])
+        stats["classes_last"] = int(evidence["classes_last"])
+        stats["rebuilds"] = int(evidence["rebuilds"])
+        stats["overlay_peak"] = int(evidence["overlay_peak"])
+        stats["compression_ratio"] = round(
+            cn / max(int(evidence["classes_initial"]), 1), 1
+        )
+        stats["parity"] = "byte-identical"
+        LANES["class-compressed cold"] = stats
+        if warm_ms:
+            warm_lat = np.array(warm_ms)
+            wstats = _lane_stats(warm_lat, int(feas.sum()))
+            wstats["nodes"] = cn
+            wstats["apps"] = ca
+            LANES["class-compressed warm"] = wstats
+        SECONDARY["class_cold_p50_ms"] = stats["p50_ms"]
+        SECONDARY["class_row_p50_ms"] = stats["row_p50_ms"]
+        SECONDARY["class_speedup_p50"] = stats["speedup_p50"]
+        print(
+            f"# [class-compressed cold] {cn}x{ca} p50={stats['p50_ms']}ms "
+            f"row_p50={stats['row_p50_ms']}ms "
+            f"speedup={stats['speedup_p50']}x "
+            f"classes={stats['classes_initial']} "
+            f"ratio={stats['compression_ratio']}x "
+            f"rebuilds={stats['rebuilds']}",
+            file=sys.stderr,
+        )
+    except Exception as err:
+        print(f"# class-compressed lane unavailable: {err}", file=sys.stderr)
 
 
 def _check_load() -> bool:

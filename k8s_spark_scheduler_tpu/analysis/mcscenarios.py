@@ -884,6 +884,150 @@ def _concurrent_commit_scenario() -> Scenario:
     )
 
 
+# ---------------------------------------------------------------------------
+# 10. ClassIndex: concurrent class rebuild vs. the digest warm check
+# ---------------------------------------------------------------------------
+
+
+def _class_rebuild_scenario() -> Scenario:
+    """The class-digest warm tier rests on two ClassIndex properties
+    under concurrency: *unchanged class revision ⟹ unchanged class
+    multiset* (the delta-solve invalidation key never lies), and the
+    incrementally maintained index equals a from-scratch rebuild of the
+    authoritative rows at every instant — so a rebuild racing a
+    warm-checking reader can never expose a divergent partition.  Both
+    (rev, content) reads happen under the mirror lock, exactly
+    TensorSnapshotCache.snapshot()'s discipline."""
+    from ..state.classindex import ClassIndex
+
+    big = np.array([8000, 16 << 30, 0], dtype=np.int64)
+    small = np.array([4000, 8 << 30, 0], dtype=np.int64)
+    zero = np.zeros(3, dtype=np.int64)
+
+    @guarded_by("_lock", "rows")
+    class Holder:
+        """Authoritative rows + the incremental index, one lock — the
+        tensor mirror's discipline in miniature."""
+
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.classes = ClassIndex()
+            # slot -> (alloc, usage)
+            self.rows = {}
+            for slot, alloc in ((0, big), (1, big), (2, small)):
+                self.note(slot, alloc, zero)
+
+        def note(self, slot, alloc, usage):
+            with self._lock:
+                racecheck.note_access(self, "rows")
+                self.rows[slot] = (alloc, usage)
+                self.classes.note_node(
+                    slot, f"n{slot}", alloc, usage, zero, 0, True, False,
+                    labels={},
+                )
+
+        def snap(self):
+            """(rev, digest, class multiset) as ONE consistent triple."""
+            with self._lock:
+                return (
+                    self.classes.class_rev,
+                    self.classes.digest,
+                    self.classes.class_sizes(),
+                )
+
+        def rebuild(self):
+            """From-scratch partition of the current authoritative rows
+            (what a cold class rebuild computes), plus the incremental
+            index's answer at the same instant."""
+            with self._lock:
+                racecheck.note_access(self, "rows")
+                fresh = ClassIndex()
+                for slot, (alloc, usage) in self.rows.items():
+                    fresh.note_node(
+                        slot, f"n{slot}", alloc, usage, zero, 0, True,
+                        False, labels={},
+                    )
+                return fresh.class_sizes(), self.classes.class_sizes()
+
+    class State:
+        def __init__(self):
+            self.holder = Holder()
+
+    def setup():
+        return State()
+
+    def threads(st: State):
+        def key_churner():
+            # slot 1 migrates between classes: every move MUST bump rev
+            st.holder.note(1, small, zero)
+            st.holder.note(1, big, zero)
+
+        def usage_churner():
+            # content-only churn on slot 2: digest flips and cancels,
+            # rev must never move on its account
+            used = zero.copy()
+            used[0] = 100
+            st.holder.note(2, small, used)
+            st.holder.note(2, small, zero)
+
+        def warm_reader():
+            rev1, dig1, sizes1 = st.holder.snap()
+            checkpoint("warm-window")
+            rev2, dig2, sizes2 = st.holder.snap()
+            if rev2 == rev1:
+                assert sizes2 == sizes1, (
+                    f"rev unchanged ({rev1}) but the class multiset "
+                    f"moved {sizes1} → {sizes2}: warm tier unsound"
+                )
+            if dig2 == dig1:
+                # digest covers a superset of the multiset: equal digest
+                # must come with an equal partition too
+                assert sizes2 == sizes1, (
+                    f"digest unchanged but multiset moved "
+                    f"{sizes1} → {sizes2}"
+                )
+
+        def rebuilder():
+            fresh, incremental = st.holder.rebuild()
+            assert fresh == incremental, (
+                f"incremental index diverged from a cold rebuild: "
+                f"{incremental} vs {fresh}"
+            )
+
+        return [
+            ("key-churn", key_churner),
+            ("usage-churn", usage_churner),
+            ("warm-a", warm_reader),
+            ("rebuild", rebuilder),
+        ]
+
+    def invariant(st: State):
+        fresh, incremental = st.holder.rebuild()
+        assert fresh == incremental, (
+            f"incremental {incremental} != rebuilt {fresh}"
+        )
+
+    def final(st: State):
+        rev, _, sizes = st.holder.snap()
+        # both churners restored their slots: back to the initial
+        # partition {big: 2, small: 1}, with the rev recording that the
+        # multiset was disturbed along the way
+        assert sorted(sizes.values()) == [1, 2], sizes
+        assert rev >= 2, f"key churn never bumped the revision: {rev}"
+
+    return Scenario(
+        name="class-rebuild-warm-check",
+        setup=setup,
+        threads=threads,
+        invariant=invariant,
+        final=final,
+        description="unchanged class revision implies an unchanged class "
+        "multiset on every interleaving of key churn, usage churn, and a "
+        "concurrent from-scratch rebuild (the class-digest warm-tier "
+        "axiom)",
+    )
+
+
 def corpus() -> List[Scenario]:
     return [
         _changefeed_scenario(),
@@ -895,4 +1039,5 @@ def corpus() -> List[Scenario]:
         _preemption_scenario(),
         _fencing_scenario(),
         _concurrent_commit_scenario(),
+        _class_rebuild_scenario(),
     ]

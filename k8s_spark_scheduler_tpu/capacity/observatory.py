@@ -34,7 +34,13 @@ from ..analysis import racecheck
 from ..analysis.guarded import guarded_by
 from ..metrics import names as mnames
 from . import in_predicate_lock
-from .probe import DEFAULT_K_MAX, frag_report, probe_headroom
+from .probe import (
+    DEFAULT_K_MAX,
+    frag_report,
+    frag_report_classes,
+    probe_headroom,
+    probe_headroom_classes,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +80,11 @@ class CapacitySample:
     # instance group -> {"used": [3], "allocatable": [3], "utilization",
     #                    "share": [3]}
     tenants: Dict[str, Dict] = field(default_factory=dict)
+    # equivalence-class lane: {"count", "ratio", "indexCount",
+    # "indexRatio", "free", "largestChunk", "fragIndex",
+    # "headroom": {shape_key: int}, "expandMs"} — O(classes) twins of
+    # the row-level analytics above, multiplicity-weighted
+    classes: Dict = field(default_factory=dict)
     queue: List[Dict] = field(default_factory=list)
     queue_truncated: int = 0      # pending drivers beyond max_queue
     queued_gangs: int = 0
@@ -100,6 +111,7 @@ class CapacitySample:
             "overdrawnNodes": [int(x) for x in self.overdrawn_nodes],
             "fragIndex": [round(float(x), 6) for x in self.frag_index],
             "headroom": self.headroom,
+            "classes": self.classes,
             "groups": self.groups,
             "tenants": self.tenants,
             "queue": self.queue,
@@ -493,9 +505,73 @@ class CapacitySampler:
                     "probes": 0,
                 }
 
+        if n > 0:
+            self._class_lane(snap, avail, eligible, shape_list, sample)
         self._tenants(snap, sample)
         self._forecast(gangs, pending, sample, now)
         return sample
+
+    def _class_lane(
+        self, snap, avail, eligible, shape_list, sample
+    ) -> None:
+        """Equivalence-class analytics (ROADMAP 2): group nodes by exact
+        (availability, schedulability) and run the frag/headroom probes
+        once per class with multiplicity weighting — O(classes) instead
+        of O(nodes), identical results (test_class_compression.py pins
+        it).  ``expandMs`` is this lane's whole wall cost: grouping +
+        weighted probes + expanding class results back to the sample's
+        node-level vocabulary."""
+        t0 = time.perf_counter()
+        try:
+            from ..native import group_rows
+
+            n_classes, cls = group_rows(
+                avail, np.asarray(eligible, dtype=np.uint8)
+            )
+            if n_classes <= 0:
+                return
+            mult = np.bincount(cls, minlength=n_classes).astype(np.int64)
+            # class ids are assigned in first-occurrence order, so the
+            # sorted-unique first indices are the representatives
+            _, reps = np.unique(cls, return_index=True)
+            class_avail = avail[reps]
+            class_elig = np.asarray(eligible, dtype=bool)[reps]
+            total, largest, _, _, frag = frag_report_classes(
+                class_avail, class_elig, mult
+            )
+            entry: Dict = {
+                "count": int(n_classes),
+                "ratio": round(len(snap.names) / n_classes, 3),
+                "free": [int(x) for x in total],
+                "largestChunk": [int(x) for x in largest],
+                "fragIndex": [round(float(x), 6) for x in frag],
+                "headroom": {},
+            }
+            if class_elig.any() and shape_list:
+                shape_rows = np.array(
+                    [list(d) + list(e) for _, (d, e) in shape_list],
+                    dtype=np.int64,
+                )
+                headroom, _, probes = probe_headroom_classes(
+                    class_avail, mult, class_elig, shape_rows, self.k_max
+                )
+                sample.probe_solves += int(probes.sum())
+                for i, (key, _) in enumerate(shape_list):
+                    entry["headroom"][key] = int(headroom[i])
+            # the state-layer identity (rounded capacity × labels × AZ ×
+            # schedulability, state/classindex.py) rides along: the
+            # tpu.classes.{count,compression.ratio} gauges report IT —
+            # the solver-facing exact grouping above is the analytics
+            # lane's own key
+            index = getattr(self._cache, "classes", None)
+            if index is not None and hasattr(index, "stats"):
+                n_cls, _n_nodes, ratio = index.stats()
+                entry["indexCount"] = int(n_cls)
+                entry["indexRatio"] = round(float(ratio), 3)
+            entry["expandMs"] = round((time.perf_counter() - t0) * 1000.0, 3)
+            sample.classes = entry
+        except Exception:
+            logger.exception("class analytics lane failed (diagnostic only)")
 
     def _per_group(
         self, snap, avail, eligible, shape_list, shape_rows, sample
@@ -723,6 +799,24 @@ class CapacitySampler:
         if hasattr(m, "prune_gauges"):
             m.prune_gauges(mnames.CAPACITY_HEADROOM, headroom_tags)
             m.prune_gauges(mnames.CAPACITY_UTILIZATION, tenant_tags)
+        if sample.classes:
+            # fleet shape diversity: the state-layer class identity when
+            # the mirror carries an index, else the analytics grouping
+            m.gauge(
+                mnames.CLASSES_COUNT,
+                float(sample.classes.get("indexCount",
+                                         sample.classes["count"])),
+            )
+            m.gauge(
+                mnames.CLASSES_COMPRESSION_RATIO,
+                float(sample.classes.get("indexRatio",
+                                         sample.classes["ratio"])),
+            )
+            if "expandMs" in sample.classes:
+                m.histogram(
+                    mnames.CLASSES_EXPAND_MS,
+                    float(sample.classes["expandMs"]),
+                )
         m.gauge(mnames.CAPACITY_QUEUED_GANGS, float(sample.queued_gangs))
         m.gauge(mnames.CAPACITY_QUEUE_PRESSURE, float(sample.pressure))
         for entry in sample.queue:

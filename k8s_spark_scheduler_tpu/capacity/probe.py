@@ -186,6 +186,113 @@ def probe_headroom(
     return headroom, usable, probes, "numpy"
 
 
+def _feasible_classes(
+    avail: np.ndarray,      # [C, 3] class representative availability
+    elig: np.ndarray,       # [C] bool
+    mult: np.ndarray,       # [C] int64 multiplicities
+    caps: np.ndarray,       # [C] per-class executor capacity
+    driver: np.ndarray,
+    executor: np.ndarray,
+    k: int,
+) -> bool:
+    """step_app_plain's admission rule over the class multiset: every
+    member of a class contributes the same clamped capacity, so
+    Σ_nodes min(cap, k) = Σ_classes min(cap_c, k)·mult_c, and the
+    driver probe only asks whether SOME member of SOME class covers the
+    driver row — verdicts are identical to the row-level rule by
+    construction."""
+    live = mult > 0
+    if k <= 0:
+        return bool((live & elig & (avail >= driver).all(axis=1)).any())
+    ck = np.clip(caps, 0, k)
+    total = int((ck * mult).sum())
+    if total < k:
+        return False
+    idx = np.flatnonzero(live & elig & (avail >= driver).all(axis=1))
+    if not len(idx):
+        return False
+    # one member of the driver class hosts the driver: its contribution
+    # switches from ck to cap-with-driver, the other mult-1 keep ck
+    cwd = np.clip(
+        caps_unclamped(avail[idx] - driver, elig[idx], executor), 0, k
+    )
+    return bool((total - ck[idx] + cwd >= k).any())
+
+
+def probe_headroom_classes(
+    class_avail: np.ndarray,  # [C, 3] int64 class representative rows
+    class_mult: np.ndarray,   # [C] int64 nodes per class
+    class_elig: np.ndarray,   # [C] bool schedulability (class-uniform)
+    shapes: np.ndarray,       # [S, 6] int64: d0..2 e0..2 (base units)
+    k_max: int = DEFAULT_K_MAX,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(headroom[S], usable[S,3], probes[S]) — the multiplicity-weighted
+    class twin of :func:`probe_headroom_numpy`: O(classes) per
+    feasibility check instead of O(nodes), identical headrooms
+    (tests/test_class_compression.py pins the parity)."""
+    avail = np.asarray(class_avail, dtype=np.int64).reshape(-1, 3)
+    mult = np.asarray(class_mult, dtype=np.int64)
+    elig = np.asarray(class_elig, dtype=bool)
+    shapes = np.asarray(shapes, dtype=np.int64).reshape(-1, 6)
+    ns = shapes.shape[0]
+    headroom = np.zeros(ns, dtype=np.int64)
+    usable = np.zeros((ns, 3), dtype=np.int64)
+    probes = np.zeros(ns, dtype=np.int64)
+    for s in range(ns):
+        d, e = shapes[s, 0:3], shapes[s, 3:6]
+        caps = caps_unclamped(avail, elig, e)
+        total_kmax = int((np.clip(caps, 0, k_max) * mult).sum())
+        usable[s] = total_kmax * e
+
+        n_probes = 0
+
+        def feasible(k: int) -> bool:
+            nonlocal n_probes
+            n_probes += 1
+            return _feasible_classes(avail, elig, mult, caps, d, e, k)
+
+        hi = min(int(k_max), total_kmax)
+        h = 0
+        if hi >= 1:
+            if feasible(hi):
+                h = hi
+            elif feasible(1):
+                lo = 1
+                while hi - lo > 1:
+                    mid = lo + (hi - lo) // 2
+                    if feasible(mid):
+                        lo = mid
+                    else:
+                        hi = mid
+                h = lo
+        headroom[s] = h
+        probes[s] = n_probes
+    return headroom, usable, probes
+
+
+def frag_report_classes(
+    class_avail: np.ndarray, class_elig: np.ndarray, class_mult: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Multiplicity-weighted class twin of :func:`frag_report` (numpy
+    lane): sums weight each class by its node count, maxima ignore the
+    weights — outputs are identical to the row-level report on the
+    expanded rows by construction."""
+    avail = np.asarray(class_avail, dtype=np.int64).reshape(-1, 3)
+    mult = np.asarray(class_mult, dtype=np.int64)
+    mask = np.asarray(class_elig, dtype=bool) & (mult > 0)
+    if avail.shape[0] == 0 or not mask.any():
+        z = np.zeros(3, dtype=np.int64)
+        return z, z.copy(), z.copy(), z.copy(), np.zeros(3, dtype=float)
+    rows = avail[mask]
+    m = mult[mask][:, None]
+    pos = np.maximum(rows, 0)
+    total = (pos * m).sum(axis=0)
+    largest = pos.max(axis=0)
+    free_nodes = ((rows > 0) * m).sum(axis=0).astype(np.int64)
+    overdrawn = ((rows < 0) * m).sum(axis=0).astype(np.int64)
+    return total, largest, free_nodes, overdrawn, _frag_index(total, largest)
+
+
 def _frag_index(total: np.ndarray, largest: np.ndarray) -> np.ndarray:
     """Shared final step of both lanes — computed from the SAME base
     units, so native and numpy are bit-identical."""

@@ -344,6 +344,31 @@ class ConcurrentConfig:
 
 
 @dataclass
+class ClassesConfig:
+    """Equivalence-class node aggregation (ROADMAP 2): class-compressed
+    native solves, the O(1) class-digest warm tier in the delta-solve
+    engine, and per-class observatory analytics.
+
+    Decisions are byte-identical enabled or disabled — the compressed
+    solver expands to concrete nodes at bind time and the property
+    suite (tests/test_class_compression.py) pins parity — so
+    ``enabled`` is an operator kill switch, not a semantics switch.
+    ``min_nodes`` keeps the compressed session solver off small fleets
+    where partition upkeep isn't worth it (the 10k perf-gate lanes run
+    the row-level path unchanged)."""
+
+    enabled: bool = True
+    min_nodes: int = 20000
+
+    @staticmethod
+    def from_dict(d: dict) -> "ClassesConfig":
+        return ClassesConfig(
+            enabled=d.get("enabled", True),
+            min_nodes=d.get("min-nodes", 20000),
+        )
+
+
+@dataclass
 class ConversionWebhookConfig:
     """Where the apiserver reaches the CRD conversion webhook (the
     reference wires this from the witchcraft server's service identity,
@@ -406,6 +431,10 @@ class Install:
     # commit gate (concurrent/) — disabled = serial extender, and
     # enabled is still decision-identical by construction
     concurrent: ConcurrentConfig = field(default_factory=ConcurrentConfig)
+    # equivalence-class aggregation: class-compressed solves at scale +
+    # class-digest warm tier (state/classindex.py, ops/deltasolve.py) —
+    # byte-identical decisions either way
+    classes: ClassesConfig = field(default_factory=ClassesConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "Install":
@@ -484,4 +513,5 @@ class Install:
             ha=HAConfig.from_dict(d.get("ha", {})),
             lifecycle=LifecycleConfig.from_dict(d.get("lifecycle", {})),
             concurrent=ConcurrentConfig.from_dict(d.get("concurrent", {})),
+            classes=ClassesConfig.from_dict(d.get("classes", {})),
         )

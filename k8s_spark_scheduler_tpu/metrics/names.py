@@ -333,6 +333,20 @@ CONCURRENT_INTENTS_FORWARDED = (
     "foundry.spark.scheduler.tpu.concurrent.intents.forwarded.count"
 )
 
+# equivalence-class aggregation (state/classindex.py + the native
+# class-compressed solver): fleet shape diversity and compression health
+# distinct node equivalence classes in the mirror (gauge)
+CLASSES_COUNT = "foundry.spark.scheduler.tpu.classes.count"
+# nodes per class — the compression the class-compressed solver enjoys
+CLASSES_COMPRESSION_RATIO = (
+    "foundry.spark.scheduler.tpu.classes.compression.ratio"
+)
+# native session partition rebuilds (overlay overflow / resume misses)
+CLASSES_REBUILD_COUNT = "foundry.spark.scheduler.tpu.classes.rebuild.count"
+# bind-time expansion latency: class placements → concrete node rows
+# (milliseconds; histogram)
+CLASSES_EXPAND_MS = "foundry.spark.scheduler.tpu.classes.expand.ms"
+
 # tag keys (metrics.go:70-85)
 TAG_SPARK_ROLE = "sparkrole"
 TAG_COLLOCATION_TYPE = "collocation-type"

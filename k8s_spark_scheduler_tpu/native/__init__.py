@@ -102,6 +102,17 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ]
             except AttributeError:
                 pass
+            try:
+                # optional: equivalence-class grouping (ROADMAP 2)
+                lib.snap_group_rows.restype = ctypes.c_int64
+                lib.snap_group_rows.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                ]
+            except AttributeError:
+                pass
             _lib = lib
         except Exception:
             logger.warning("native snapshot library unavailable; using numpy fallback",
@@ -217,6 +228,43 @@ def rows_equal(a: np.ndarray, b: np.ndarray) -> bool:
         )
         return diff < 0
     return bool(np.array_equal(a, b))
+
+
+def group_rows(rows: np.ndarray, flags: Optional[np.ndarray] = None
+               ) -> Tuple[int, np.ndarray]:
+    """Equivalence-class grouping of [n, 3] int64 rows (plus an optional
+    per-row uint8 flag, e.g. schedulability): returns (class count,
+    class id per row in first-occurrence order).  The capacity
+    observatory's per-class headroom/frag lanes use it to collapse a
+    100k-node scan to a few dozen class probes.  Native one-pass hash
+    when the library carries snap_group_rows, numpy otherwise; the class
+    id assignment is identical (first-occurrence order) either way."""
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    n = rows.shape[0]
+    out = np.zeros(n, dtype=np.int32)
+    if n == 0:
+        return 0, out
+    if flags is not None:
+        flags = np.ascontiguousarray(flags, dtype=np.uint8)
+    lib = _build_and_load()
+    if lib is not None and hasattr(lib, "snap_group_rows"):
+        n_classes = lib.snap_group_rows(
+            rows.ctypes.data_as(ctypes.c_void_p),
+            flags.ctypes.data_as(ctypes.c_void_p) if flags is not None else None,
+            n,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return int(n_classes), out
+    seen: dict = {}
+    for i in range(n):
+        key = (int(rows[i, 0]), int(rows[i, 1]), int(rows[i, 2]),
+               int(flags[i]) if flags is not None else 0)
+        cid = seen.get(key)
+        if cid is None:
+            cid = len(seen)
+            seen[key] = cid
+        out[i] = cid
+    return len(seen), out
 
 
 def scale_rows_int32(avail_rows: np.ndarray, demand_rows: np.ndarray, node_bucket: int):

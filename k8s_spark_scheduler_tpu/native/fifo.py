@@ -106,6 +106,20 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             except AttributeError:
                 pass
             try:
+                # equivalence-class compressed lanes (ROADMAP 2) —
+                # optional for the same prebuilt-library reason
+                lib.fifo_solve_queue_classes.restype = ctypes.c_int
+                lib.fifo_solve_queue_classes.argtypes = [
+                    ctypes.c_int64, ctypes.c_int64, _P, _P, _P, _P,
+                    ctypes.c_int, _P, _P, _P,
+                ]
+                lib.fifo_sess_set_classes.restype = None
+                lib.fifo_sess_set_classes.argtypes = [_P, ctypes.c_int]
+                lib.fifo_sess_class_stats.restype = None
+                lib.fifo_sess_class_stats.argtypes = [_P, _P]
+            except AttributeError:
+                pass
+            try:
                 # decision-provenance explainer (PR 6) — optional for the
                 # same prebuilt-library reason as the session API
                 lib.fifo_explain_queue.restype = ctypes.c_int
@@ -329,6 +343,48 @@ def solve_packed_cold(
     )
 
 
+def native_classes_available() -> bool:
+    lib = _build_and_load()
+    return lib is not None and hasattr(lib, "fifo_solve_queue_classes")
+
+
+def solve_packed_classes(
+    policy_code: int,
+    avail: np.ndarray,        # [N, 3] int32 basis (not mutated)
+    driver_rank: np.ndarray,  # [N] int32
+    exec_ok: np.ndarray,      # [N] bool
+    apps_packed: np.ndarray,  # [A, 8] int32: d0..2 e0..2 count valid
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, dict]:
+    """Stateless class-compressed solve of a session-format packed queue
+    (fifo_solver.cpp ``fifo_solve_queue_classes``): byte-identical
+    verdicts and post-queue availability to :func:`solve_packed_cold` at
+    the same inputs, with per-app cost O(classes + diverged overlay)
+    instead of O(nodes).  The fourth element is the compression evidence:
+    ``{"classes_initial", "rebuilds", "overlay_peak", "classes_last"}``."""
+    lib = _build_and_load()
+    if lib is None or not hasattr(lib, "fifo_solve_queue_classes"):
+        raise RuntimeError("native class-compressed solver not available")
+    avail_io = np.ascontiguousarray(avail, dtype=np.int32).copy()
+    rank = np.ascontiguousarray(driver_rank, dtype=np.int32)
+    eok = np.ascontiguousarray(exec_ok, dtype=np.uint8)
+    apps = np.ascontiguousarray(apps_packed, dtype=np.int32)
+    nb, na = avail_io.shape[0], apps.shape[0]
+    feas = np.zeros(max(na, 1), dtype=np.uint8)
+    didx = np.zeros(max(na, 1), dtype=np.int32)
+    stats = np.zeros(4, dtype=np.int64)
+    lib.fifo_solve_queue_classes(
+        nb, na, _c(avail_io), _c(rank), _c(eok), _c(apps),
+        int(policy_code), _c(feas), _c(didx), _c(stats),
+    )
+    evidence = {
+        "classes_initial": int(stats[0]),
+        "rebuilds": int(stats[1]),
+        "overlay_peak": int(stats[2]),
+        "classes_last": int(stats[3]),
+    }
+    return feas[:na].astype(bool), didx[:na], avail_io, evidence
+
+
 def native_session_available() -> bool:
     lib = _build_and_load()
     return lib is not None and hasattr(lib, "fifo_sess_create")
@@ -409,6 +465,32 @@ class NativeFifoSession:
         if not getattr(self, "_handle", None):
             return 0
         return int(self._lib.fifo_sess_mem_bytes(self._handle))
+
+    def set_classes(self, enable: bool) -> bool:
+        """Toggle equivalence-class compressed stepping (ROADMAP 2).
+        Verdicts and planes stay byte-identical either way; returns
+        whether the loaded extension supports the mode (older prebuilt
+        libraries silently stay row-level)."""
+        if not hasattr(self._lib, "fifo_sess_set_classes"):
+            return False
+        self._lib.fifo_sess_set_classes(self._handle, int(bool(enable)))
+        return True
+
+    def class_stats(self) -> dict:
+        """Compression evidence of the session's class partition:
+        ``{"classes_last", "rebuilds", "overlay_peak", "overlay_now"}``
+        (zeros until class mode has stepped, or when unsupported)."""
+        out = np.zeros(4, dtype=np.int64)
+        if getattr(self, "_handle", None) and hasattr(
+            self._lib, "fifo_sess_class_stats"
+        ):
+            self._lib.fifo_sess_class_stats(self._handle, _c(out))
+        return {
+            "classes_last": int(out[0]),
+            "rebuilds": int(out[1]),
+            "overlay_peak": int(out[2]),
+            "overlay_now": int(out[3]),
+        }
 
 
 def native_explain_available() -> bool:

@@ -107,6 +107,17 @@ class _Session:
     exec_ok: np.ndarray       # [Nb] bool
     nb: int
     content_key: tuple        # snapshot content sequence last verified
+    # class-digest warm tier (state/classindex.py): the XOR content
+    # digest + class-structure revision of the snapshot this basis was
+    # built from.  (-1, -1) = snapshot didn't carry a digest (tests
+    # building bare TensorSnapshots); the tier then stands aside.
+    class_digest: tuple = (-1, -1)
+    class_rev: int = -1
+    # class-compressed solve mode: on for big fleets only (min_nodes);
+    # last_rebuilds tracks the native partition-rebuild counter so the
+    # tpu.classes.rebuild.count metric gets deltas, not running totals
+    use_classes: bool = False
+    last_rebuilds: int = 0
 
 
 @guarded_by("_lock", "_sessions", "_stats", "_resume_depths", "_parity_count")
@@ -142,6 +153,12 @@ class DeltaSolveEngine:
         self.parity_interval = 0
         self.parity_hooks = None  # (on_ok, on_mismatch) callables
         self._parity_count = 0
+        # equivalence-class aggregation (Install.classes): the O(1)
+        # digest warm tier below and the native session's class-
+        # compressed solve mode.  Set at wiring before serving starts,
+        # only read here — no lock needed.
+        self.classes_enabled = True
+        self.classes_min_nodes = 20000
 
     # -- availability --------------------------------------------------------
 
@@ -205,6 +222,7 @@ class DeltaSolveEngine:
             depths = sorted(self._resume_depths)
             hits = self._stats["warm_hits"]
             cold = self._stats["cold_solves"]
+            digest_hits = self._stats.get("digest_hits", 0)
             misses = dict(self._stats["misses"])
             sessions = len(self._sessions)
             session_bytes = sum(
@@ -214,6 +232,7 @@ class DeltaSolveEngine:
         return {
             "warm_hits": hits,
             "cold_solves": cold,
+            "digest_hits": digest_hits,
             "misses": misses,
             "warm_hit_rate": (hits / total) if total else 0.0,
             "resume_depth_p50": (
@@ -325,8 +344,29 @@ class DeltaSolveEngine:
         warm = False
         scaled = None
         if sess is not None:
+            snap_digest = getattr(snap, "class_digest", (-1, -1))
             if sess.content_key == snap.content_key:
                 warm = True
+            elif (
+                self.classes_enabled
+                and sess.class_digest != (-1, -1)
+                and snap_digest == sess.class_digest
+            ):
+                # O(1) class-digest tier (state/classindex.py): the XOR
+                # node-content digest cancelled back to the session's —
+                # same-class node churn (create/release, cordon/uncordon
+                # round trips) warms without the O(N) row compare.  The
+                # digest hashes a superset of what rows_equal checks, so
+                # equality ⟹ equal rows up to 64-bit XOR collisions;
+                # the warm≠cold parity guard audits the conclusion.
+                warm = True
+                sess.content_key = snap.content_key
+                sess.class_rev = getattr(snap, "class_rev", -1)
+                with self._lock:
+                    racecheck.note_access(self, "_stats")
+                    self._stats["digest_hits"] = (
+                        self._stats.get("digest_hits", 0) + 1
+                    )
             else:
                 from ..native import rows_equal
 
@@ -339,6 +379,8 @@ class DeltaSolveEngine:
                     # then released): the basis is still exact
                     warm = True
                     sess.content_key = snap.content_key
+                    sess.class_digest = snap_digest
+                    sess.class_rev = getattr(snap, "class_rev", -1)
         if warm:
             scaled = self._scale_apps(apps, sess.scale, sess.nb)
             if scaled is None:
@@ -375,6 +417,17 @@ class DeltaSolveEngine:
                 )
             gate_span.tag("resumeFrom", int(resume))
             gate_span.tag("warm", warm)
+            if sess.use_classes and self._metrics is not None:
+                try:
+                    st = sess.native.class_stats()
+                    delta = st["rebuilds"] - sess.last_rebuilds
+                    if delta > 0:
+                        sess.last_rebuilds = st["rebuilds"]
+                        self._metrics.counter(
+                            mnames.CLASSES_REBUILD_COUNT, inc=float(delta)
+                        )
+                except Exception:
+                    pass
             if warm:
                 self._record_warm(resume)
                 if self.parity_interval:
@@ -614,6 +667,20 @@ class DeltaSolveEngine:
             problem.avail, problem.driver_rank, problem.exec_ok,
             policy_code, stride=self._stride,
         )
+        # class-compressed solve mode at scale: partition upkeep only
+        # pays for itself on big fleets, so small clusters (and the 10k
+        # perf-gate lanes) keep the row-level step functions verbatim.
+        # Decisions are byte-identical either way (PR 20 parity suite).
+        use_classes = False
+        if hasattr(native, "set_classes"):
+            want = (
+                self.classes_enabled
+                and int(problem.avail.shape[0]) >= self.classes_min_nodes
+            )
+            # always called (even want=False): a reused evictee handle
+            # must not carry the previous build's class mode
+            supported = native.set_classes(want)
+            use_classes = want and supported
         na = apps.driver.shape[0]
         sess = _Session(
             native=native,
@@ -628,6 +695,9 @@ class DeltaSolveEngine:
             exec_ok=problem.exec_ok,
             nb=int(problem.avail.shape[0]),
             content_key=snap.content_key,
+            class_digest=getattr(snap, "class_digest", (-1, -1)),
+            class_rev=getattr(snap, "class_rev", -1),
+            use_classes=use_classes,
         )
         with self._lock:
             racecheck.note_access(self, "_sessions")

@@ -605,6 +605,11 @@ def init_server_with_clients(
             provenance_tracker.on_parity_ok,
             provenance_tracker.on_parity_mismatch,
         )
+    if extender.delta_engine is not None:
+        # equivalence-class aggregation (Install.classes): the O(1)
+        # digest warm tier + class-compressed native solves at scale
+        extender.delta_engine.classes_enabled = install.classes.enabled
+        extender.delta_engine.classes_min_nodes = install.classes.min_nodes
     marker = UnschedulablePodMarker(
         api,
         node_informer,

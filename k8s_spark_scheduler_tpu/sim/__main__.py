@@ -71,6 +71,12 @@ def main(argv=None) -> int:
         help="replay a flight-recorder bundle file and assert "
         "byte-identical verdicts (no scenario needed)",
     )
+    parser.add_argument(
+        "--override-nodes", type=int, default=None, metavar="N",
+        help="override scenario.cluster.nodes — CI runs the 100k-node "
+        "class-churn scenario scaled down; digests are only comparable "
+        "at the same node count",
+    )
     parser.add_argument("--quiet", action="store_true", help="suppress the summary dump")
     args = parser.parse_args(argv)
 
@@ -82,6 +88,8 @@ def main(argv=None) -> int:
     scenario = Scenario.from_file(args.scenario)
     if args.seed is not None:
         scenario.seed = args.seed
+    if args.override_nodes is not None:
+        scenario.cluster.nodes = args.override_nodes
 
     if args.dump_trace:
         apps = WorkloadGenerator(scenario.workload, scenario.seed).generate(scenario.duration)

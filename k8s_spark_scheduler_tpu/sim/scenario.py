@@ -76,6 +76,11 @@ auditor arms the I-P1..I-P4 policy invariants.  An ``ha`` dict (the
 wires the HA fabric — lease election + fencing + takeover
 reconciliation — stepped deterministically on the virtual clock
 (``background`` is forced off), and arms the I-H1..I-H3 audits.
+A ``classes`` dict (the ``Install.classes`` kebab-case keys from
+``config.ClassesConfig.from_dict``) overrides the equivalence-class
+aggregation config — the class-churn scenarios force ``min-nodes: 0``
+so cordon/uncordon faults exercise live class-membership flips at any
+fleet size.
 """
 
 from __future__ import annotations
@@ -108,6 +113,7 @@ _SCENARIO_KEYS = {
     "name", "seed", "duration", "retry_interval", "binpack_algo",
     "fifo", "cluster", "workload", "autoscaler", "faults",
     "unschedulable_scan_interval", "policy", "ha", "concurrent",
+    "classes",
 }
 _CLUSTER_KEYS = {"nodes", "cpu", "memory", "gpu", "zones", "instance_group"}
 _AUTOSCALER_KEYS = {
@@ -271,6 +277,12 @@ class Scenario:
     # speculate→FIFO-commit path — decisions must stay byte-identical
     # to the serial run of the same scenario
     concurrent: Dict = field(default_factory=dict)
+    # Install.classes overrides (kebab-case, ClassesConfig.from_dict);
+    # empty = the Install defaults (enabled, min-nodes 20000).  Set
+    # {"enabled": true, "min-nodes": 0} to force class-compressed
+    # solves regardless of fleet size — the class-churn scenarios do,
+    # so cordon/uncordon faults flip live class memberships
+    classes: Dict = field(default_factory=dict)
 
     @staticmethod
     def from_dict(d: Dict) -> "Scenario":
@@ -297,7 +309,7 @@ class Scenario:
         faults_d = d.pop("faults", [])
         _validate_faults(faults_d)
         _validate_workload(d.get("workload", {}))
-        for key in ("policy", "ha", "concurrent"):
+        for key in ("policy", "ha", "concurrent", "classes"):
             if key in d and not isinstance(d[key], dict):
                 raise ScenarioError(
                     f"scenario.{key}: expected an object, got {type(d[key]).__name__}"

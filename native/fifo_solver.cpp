@@ -28,6 +28,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <new>
 #include <thread>
@@ -784,6 +785,556 @@ void join_planes(const std::vector<int32_t>& a0, const std::vector<int32_t>& a1,
 }
 
 // ---------------------------------------------------------------------------
+// Equivalence-class compressed stepping (ROADMAP 2: the Firmament /
+// Borg-style node-aggregation relaxation).  Real fleets have a few
+// dozen machine shapes, so most of the per-app O(nodes) capacity pass
+// recomputes identical divisions.  The class solver partitions nodes by
+// EXACT (avail triple, exec_ok) equality, evaluates each capacity
+// formula once per class, and weights by multiplicity.  Nodes whose
+// planes diverge from their class representative (because a placement
+// wrote them) move to a small sorted overlay evaluated per node; when
+// the overlay outgrows nb/32 the partition is rebuilt in one O(nb)
+// hash pass.
+//
+// Parity is by construction, not by approximation:
+//  - the planes stay authoritative — every plane read (driver probe,
+//    subtraction, checkpointing) is the row solver's exact read;
+//  - live class members share the representative triple EXACTLY, so
+//    the per-class capacity equals the per-row capacity;
+//  - fills and drains walk merged per-class member cursors + the
+//    overlay in ascending node order — the same node visit order as
+//    the row loops — and bind concrete node ids at that moment
+//    (deterministic bind-time expansion);
+//  - min-frag class values come from mf_cap_one (clamped at 0), which
+//    is observationally equivalent to the row pass's unclamped
+//    negatives: every consumer filters on c > 0 / c >= k / equality
+//    with a positive value.
+// The property suite (tests/test_class_compression.py) re-verifies the
+// byte-identity across seeds, policies, and session lanes.
+// ---------------------------------------------------------------------------
+
+inline uint64_t class_hash(int32_t a0, int32_t a1, int32_t a2, uint8_t e) {
+  uint64_t h = static_cast<uint32_t>(a0);
+  h = h * 0x9E3779B97F4A7C15ull + static_cast<uint32_t>(a1);
+  h = h * 0x9E3779B97F4A7C15ull + static_cast<uint32_t>(a2);
+  h = h * 0x9E3779B97F4A7C15ull + e;
+  h ^= h >> 29;
+  h *= 0xBF58476D1CE4E5B9ull;
+  h ^= h >> 32;
+  return h;
+}
+
+struct ClassSolver {
+  struct Cls {
+    int32_t a[kDims];
+    uint8_t eok = 0;
+    int32_t live = 0;                // members whose planes still match a[]
+    std::vector<int32_t> members;    // ascending node ids (dead ones are
+                                     // skipped via node_cls mismatch)
+  };
+  std::vector<Cls> classes;
+  std::vector<int32_t> node_cls;  // node -> class id, -1 = overlay (diverged)
+  std::vector<int32_t> ov_nodes;  // ascending diverged node ids
+  // open-addressing hash over class keys (power-of-two table)
+  std::vector<int32_t> table;
+  uint64_t mask = 0;
+  int64_t nb = 0;
+  int64_t ov_limit = 0;
+  // per-app scratch (allocation-free steady state)
+  std::vector<int32_t> cls_caps;   // per-class capacity value
+  std::vector<int32_t> ov_caps;    // per-overlay-entry capacity value
+  std::vector<size_t> cls_cur;     // per-class member cursor (fills)
+  std::vector<int32_t> newly;      // nodes written by the current app
+  std::vector<int32_t> merge_tmp;  // fresh overlay ids to splice in
+  std::vector<std::pair<int32_t, int32_t>> heap;  // (node, source) min-heap
+  // compression evidence for the bench lane / session stats
+  int64_t classes_last = 0;  // class count at the most recent rebuild
+  int64_t rebuilds = 0;
+  int64_t ov_peak = 0;
+};
+
+void class_rebuild(ClassSolver& cs, const int32_t* a0, const int32_t* a1,
+                   const int32_t* a2, const uint8_t* eok, int64_t nb) {
+  cs.nb = nb;
+  cs.classes.clear();
+  cs.node_cls.assign(nb, -1);
+  cs.ov_nodes.clear();
+  uint64_t want = 16;
+  while (want < static_cast<uint64_t>(nb) * 2) want <<= 1;
+  cs.table.assign(want, -1);
+  cs.mask = want - 1;
+  for (int64_t i = 0; i < nb; ++i) {
+    uint64_t slot = class_hash(a0[i], a1[i], a2[i], eok[i]) & cs.mask;
+    int32_t id = -1;
+    while (true) {
+      const int32_t t = cs.table[slot];
+      if (t < 0) break;
+      const ClassSolver::Cls& c = cs.classes[t];
+      if (c.a[0] == a0[i] && c.a[1] == a1[i] && c.a[2] == a2[i] &&
+          c.eok == eok[i]) {
+        id = t;
+        break;
+      }
+      slot = (slot + 1) & cs.mask;
+    }
+    if (id < 0) {
+      id = static_cast<int32_t>(cs.classes.size());
+      ClassSolver::Cls c;
+      c.a[0] = a0[i];
+      c.a[1] = a1[i];
+      c.a[2] = a2[i];
+      c.eok = eok[i];
+      cs.classes.push_back(std::move(c));
+      cs.table[slot] = id;
+    }
+    cs.classes[id].members.push_back(static_cast<int32_t>(i));
+    ++cs.classes[id].live;
+    cs.node_cls[i] = id;
+  }
+  // rebuild threshold: a rebuild is one O(nb) hash pass (~1 ms at
+  // 100k), while every app pays O(overlay) — nb/64 keeps the mean
+  // overlay cost below the per-app class pass without rebuild churn
+  cs.ov_limit = std::max<int64_t>(int64_t{512}, nb / 64);
+  cs.classes_last = static_cast<int64_t>(cs.classes.size());
+  ++cs.rebuilds;
+}
+
+// Node's capacity under the current per-class / per-overlay values
+// (driver-probe read: identical to the row pass's cap[i] because live
+// members share the representative triple exactly).
+inline int32_t class_cap_at(const ClassSolver& cs, int32_t i) {
+  const int32_t c = cs.node_cls[i];
+  if (c >= 0) return cs.cls_caps[c];
+  const auto it =
+      std::lower_bound(cs.ov_nodes.begin(), cs.ov_nodes.end(), i);
+  return cs.ov_caps[static_cast<size_t>(it - cs.ov_nodes.begin())];
+}
+
+// Fold the nodes the current app wrote into the overlay (they diverged
+// from their class representative); rebuild the whole partition once
+// the overlay outgrows its bound.  `newly` holds unique node ids.
+void class_absorb(ClassSolver& cs, const int32_t* a0, const int32_t* a1,
+                  const int32_t* a2, const uint8_t* eok) {
+  if (cs.newly.empty()) return;
+  std::sort(cs.newly.begin(), cs.newly.end());
+  cs.merge_tmp.clear();
+  for (const int32_t i : cs.newly) {
+    const int32_t c = cs.node_cls[i];
+    if (c < 0) continue;  // already diverged in an earlier step
+    cs.node_cls[i] = -1;
+    --cs.classes[c].live;
+    cs.merge_tmp.push_back(i);
+  }
+  cs.newly.clear();
+  if (cs.merge_tmp.empty()) return;
+  const size_t before = cs.ov_nodes.size();
+  cs.ov_nodes.insert(cs.ov_nodes.end(), cs.merge_tmp.begin(),
+                     cs.merge_tmp.end());
+  std::inplace_merge(cs.ov_nodes.begin(),
+                     cs.ov_nodes.begin() + static_cast<int64_t>(before),
+                     cs.ov_nodes.end());
+  cs.ov_peak =
+      std::max(cs.ov_peak, static_cast<int64_t>(cs.ov_nodes.size()));
+  if (static_cast<int64_t>(cs.ov_nodes.size()) > cs.ov_limit) {
+    class_rebuild(cs, a0, a1, a2, eok, cs.nb);
+  }
+}
+
+// One tightly/evenly FIFO step over the class partition — same contract
+// as step_app_plain (mutates planes on success, returns didx or -1) and
+// byte-identical verdicts/planes by construction.
+int32_t step_app_plain_classes(ClassSolver& cs, int32_t* a0, int32_t* a1,
+                               int32_t* a2, const uint8_t* exec_ok,
+                               int64_t nb, const std::vector<int32_t>& cand,
+                               const int32_t* d, const int32_t* e, int32_t k,
+                               int evenly) {
+  const int64_t nc = static_cast<int64_t>(cs.classes.size());
+  cs.cls_caps.resize(nc);
+  int64_t total = 0;
+  for (int64_t c = 0; c < nc; ++c) {
+    const ClassSolver::Cls& cl = cs.classes[c];
+    const int32_t cap = cl.eok ? clamped_cap(cl.a, e, k) : 0;
+    cs.cls_caps[c] = cap;
+    total += static_cast<int64_t>(cap) * cl.live;
+  }
+  const int64_t nov = static_cast<int64_t>(cs.ov_nodes.size());
+  cs.ov_caps.resize(nov);
+  for (int64_t j = 0; j < nov; ++j) {
+    const int32_t i = cs.ov_nodes[j];
+    const int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+    const int32_t cap = exec_ok[i] ? clamped_cap(a, e, k) : 0;
+    cs.ov_caps[j] = cap;
+    total += cap;
+  }
+
+  // driver probe — the row walk verbatim (planes are authoritative)
+  int32_t didx = -1;
+  int32_t capd = 0;
+  if (total >= k) {
+    for (const int32_t i : cand) {
+      const int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+      if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+      int32_t am[kDims];
+      for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+      const int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+      if (total - class_cap_at(cs, i) + cwd >= k) {
+        didx = i;
+        capd = cwd;
+        break;
+      }
+    }
+  }
+  if (didx < 0) return -1;
+
+  // fill: merged ascending walk over the positive-capacity nodes.
+  // Sources: one cursor per class (live members, didx excluded), one
+  // overlay cursor, and the didx singleton carrying capd — together
+  // they enumerate exactly the nodes the row loop would visit, in the
+  // same order.  Source ids: [0, nc) classes, nc overlay, nc+1 didx.
+  const int32_t kSrcOv = static_cast<int32_t>(nc);
+  const int32_t kSrcD = static_cast<int32_t>(nc) + 1;
+  cs.cls_cur.assign(static_cast<size_t>(nc), 0);
+  cs.heap.clear();
+  auto cls_next = [&](int32_t c) -> int32_t {
+    const ClassSolver::Cls& cl = cs.classes[c];
+    size_t& cur = cs.cls_cur[c];
+    while (cur < cl.members.size()) {
+      const int32_t m = cl.members[cur++];
+      if (cs.node_cls[m] == c && m != didx) return m;
+    }
+    return -1;
+  };
+  int64_t ov_cur = 0;
+  int64_t ov_head_j = -1;
+  auto ov_next = [&]() -> int32_t {
+    while (ov_cur < nov) {
+      const int64_t j = ov_cur++;
+      if (cs.ov_caps[j] > 0 && cs.ov_nodes[j] != didx) {
+        ov_head_j = j;
+        return cs.ov_nodes[j];
+      }
+    }
+    ov_head_j = -1;
+    return -1;
+  };
+  const auto hcmp = [](const std::pair<int32_t, int32_t>& x,
+                       const std::pair<int32_t, int32_t>& y) {
+    return x.first > y.first;  // min-heap on node id
+  };
+  for (int32_t c = 0; c < nc; ++c) {
+    if (cs.cls_caps[c] <= 0) continue;
+    const int32_t n = cls_next(c);
+    if (n >= 0) cs.heap.emplace_back(n, c);
+  }
+  {
+    const int32_t n = ov_next();
+    if (n >= 0) cs.heap.emplace_back(n, kSrcOv);
+  }
+  if (capd > 0) cs.heap.emplace_back(didx, kSrcD);
+  std::make_heap(cs.heap.begin(), cs.heap.end(), hcmp);
+
+  auto sub_exec = [&](int32_t i) {
+    a0[i] = wrap_sub(a0[i], e[0]);
+    a1[i] = wrap_sub(a1[i], e[1]);
+    a2[i] = wrap_sub(a2[i], e[2]);
+  };
+  cs.newly.clear();
+  bool driver_hosts_exec = false;
+  int64_t cum = 0;      // tightly: cumulative capacity
+  int32_t placed = 0;   // evenly: hosting nodes
+  while (!cs.heap.empty()) {
+    if (evenly ? placed >= k : cum >= k) break;
+    std::pop_heap(cs.heap.begin(), cs.heap.end(), hcmp);
+    const auto [i, src] = cs.heap.back();
+    cs.heap.pop_back();
+    int32_t cap_i;
+    int32_t nxt = -1;
+    if (src == kSrcD) {
+      cap_i = capd;
+    } else if (src == kSrcOv) {
+      cap_i = cs.ov_caps[ov_head_j];
+      nxt = ov_next();
+    } else {
+      cap_i = cs.cls_caps[src];
+      nxt = cls_next(src);
+    }
+    if (nxt >= 0) {
+      cs.heap.emplace_back(nxt, src);
+      std::push_heap(cs.heap.begin(), cs.heap.end(), hcmp);
+    }
+    cum += cap_i;
+    ++placed;
+    if (i == didx) driver_hosts_exec = true;
+    sub_exec(i);
+    cs.newly.push_back(i);
+  }
+  if (!driver_hosts_exec) {
+    a0[didx] = wrap_sub(a0[didx], d[0]);
+    a1[didx] = wrap_sub(a1[didx], d[1]);
+    a2[didx] = wrap_sub(a2[didx], d[2]);
+    cs.newly.push_back(didx);
+  }
+  class_absorb(cs, a0, a1, a2, exec_ok);
+  return didx;
+}
+
+// --- class-structured min-frag drain -----------------------------------
+// The row drain orders nodes by capacity VALUE (instant fit = smallest
+// value ≥ remainder, then drain the max value in node order).  The class
+// variant keeps a value-ordered map whose entries enumerate the nodes
+// holding that value — per-class member cursors, an overlay list, and
+// the didx singleton — and pops the globally earliest node among the
+// sources, reproducing the bucketed drain's consumed-prefix node order.
+
+struct ClsDrainVal {
+  // (class id, member cursor, cached head node or kBig) triples
+  std::vector<std::array<int32_t, 3>> cls;
+  std::vector<int32_t> ov;  // ascending overlay node ids with this value
+  size_t ov_cur = 0;
+  bool has_didx = false;
+};
+
+int32_t cls_drain_head(const ClassSolver& cs, ClsDrainVal& dv, int32_t didx) {
+  int32_t best = kBig;
+  for (auto& src : dv.cls) {
+    if (src[2] == kBig && src[1] >= 0) {
+      // refresh the cached head: next live member != didx
+      const ClassSolver::Cls& cl = cs.classes[src[0]];
+      int32_t head = kBig;
+      size_t cur = static_cast<size_t>(src[1]);
+      while (cur < cl.members.size()) {
+        const int32_t m = cl.members[cur];
+        if (cs.node_cls[m] == src[0] && m != didx) {
+          head = m;
+          break;
+        }
+        ++cur;
+      }
+      src[1] = static_cast<int32_t>(cur);
+      src[2] = head;
+      if (head == kBig) src[1] = -1;  // exhausted
+    }
+    if (src[2] < best) best = src[2];
+  }
+  if (dv.ov_cur < dv.ov.size()) best = std::min(best, dv.ov[dv.ov_cur]);
+  if (dv.has_didx && didx < best) best = didx;
+  return best == kBig ? -1 : best;
+}
+
+void cls_drain_advance(const ClassSolver& cs, ClsDrainVal& dv, int32_t node,
+                       int32_t didx) {
+  if (dv.has_didx && node == didx) {
+    dv.has_didx = false;
+    return;
+  }
+  if (dv.ov_cur < dv.ov.size() && dv.ov[dv.ov_cur] == node) {
+    ++dv.ov_cur;
+    return;
+  }
+  for (auto& src : dv.cls) {
+    if (src[2] == node) {
+      ++src[1];
+      src[2] = kBig;  // head consumed; refresh lazily
+      return;
+    }
+  }
+}
+
+bool cls_drain_exhausted(const ClassSolver& cs, ClsDrainVal& dv,
+                         int32_t didx) {
+  return cls_drain_head(cs, dv, didx) < 0;
+}
+
+// One minimal-fragmentation FIFO step over the class partition — same
+// contract as step_app_minfrag, byte-identical by construction.
+int32_t step_app_minfrag_classes(ClassSolver& cs, int32_t* a0, int32_t* a1,
+                                 int32_t* a2, const uint8_t* exec_ok,
+                                 int64_t nb,
+                                 const std::vector<int32_t>& cand,
+                                 const int32_t* d, const int32_t* e,
+                                 int32_t k, MfSegs& segs) {
+  const int64_t nc = static_cast<int64_t>(cs.classes.size());
+  cs.cls_caps.resize(nc);
+  int64_t total = 0;
+  for (int64_t c = 0; c < nc; ++c) {
+    const ClassSolver::Cls& cl = cs.classes[c];
+    const int32_t v = cl.eok ? mf_cap_one(cl.a[0], cl.a[1], cl.a[2], e) : 0;
+    cs.cls_caps[c] = v;
+    total += static_cast<int64_t>(std::clamp<int32_t>(v, 0, k)) * cl.live;
+  }
+  const int64_t nov = static_cast<int64_t>(cs.ov_nodes.size());
+  cs.ov_caps.resize(nov);
+  for (int64_t j = 0; j < nov; ++j) {
+    const int32_t i = cs.ov_nodes[j];
+    const int32_t v =
+        exec_ok[i] ? mf_cap_one(a0[i], a1[i], a2[i], e) : 0;
+    cs.ov_caps[j] = v;
+    total += std::clamp<int32_t>(v, 0, k);
+  }
+
+  int32_t didx = -1;
+  if (total >= k) {
+    for (const int32_t i : cand) {
+      const int32_t a[kDims] = {a0[i], a1[i], a2[i]};
+      if (a[0] < d[0] || a[1] < d[1] || a[2] < d[2]) continue;
+      int32_t am[kDims];
+      for (int j = 0; j < kDims; ++j) am[j] = wrap_sub(a[j], d[j]);
+      const int32_t cwd = exec_ok[i] ? clamped_cap(am, e, k) : 0;
+      if (total - std::clamp<int32_t>(class_cap_at(cs, i), 0, k) + cwd >= k) {
+        didx = i;
+        break;
+      }
+    }
+  }
+  if (didx < 0) return -1;
+
+  // driver-node fix-up: didx contributes its own value (mf_cap_one on
+  // avail − driver when eligible, 0 otherwise) and is excluded from its
+  // class's multiplicity everywhere below
+  int32_t dval = 0;
+  if (exec_ok[didx]) {
+    dval = mf_cap_one(wrap_sub(a0[didx], d[0]), wrap_sub(a1[didx], d[1]),
+                      wrap_sub(a2[didx], d[2]), e);
+  }
+  const int32_t didx_cls = cs.node_cls[didx];
+  auto eff_live = [&](int64_t c) {
+    return cs.classes[c].live - (didx_cls == static_cast<int32_t>(c) ? 1 : 0);
+  };
+
+  bool placed_any = false;
+  segs.clear();
+  if (k > 0) {
+    // extremes over the implied by-node capacity vector
+    int32_t maxc = 0, min_ge = kBig, min_pos = kBig;
+    auto fold = [&](int32_t v) {
+      maxc = std::max(maxc, v);
+      if (v >= k && v < min_ge) min_ge = v;
+      if (v > 0 && v < min_pos) min_pos = v;
+    };
+    for (int64_t c = 0; c < nc; ++c) {
+      if (eff_live(c) > 0) fold(cs.cls_caps[c]);
+    }
+    for (int64_t j = 0; j < nov; ++j) {
+      if (cs.ov_nodes[j] != didx) fold(cs.ov_caps[j]);
+    }
+    fold(dval);
+
+    if (maxc > 0) {
+      const bool has_sent = maxc == kMfSent;
+      const bool attempt_subset = has_sent || k < maxc;
+      const int64_t target =
+          has_sent ? static_cast<int64_t>(kMfSent)
+                   : (attempt_subset
+                          ? (k + static_cast<int64_t>(maxc)) / 2
+                          : 0);
+
+      auto place_first_with = [&](int32_t value) {
+        int32_t best = kBig;
+        for (int64_t c = 0; c < nc; ++c) {
+          if (cs.cls_caps[c] != value || eff_live(c) <= 0) continue;
+          for (const int32_t m : cs.classes[c].members) {
+            if (cs.node_cls[m] == static_cast<int32_t>(c) && m != didx) {
+              best = std::min(best, m);
+              break;
+            }
+          }
+        }
+        for (int64_t j = 0; j < nov; ++j) {
+          if (cs.ov_caps[j] == value && cs.ov_nodes[j] != didx) {
+            best = std::min(best, cs.ov_nodes[j]);
+            break;
+          }
+        }
+        if (dval == value) best = std::min(best, didx);
+        segs.emplace_back(best, static_cast<int64_t>(k));
+      };
+
+      // value-ordered drain over the class-structured capacity multiset
+      auto drain = [&](int64_t bound) -> bool {
+        std::map<int32_t, ClsDrainVal> vals;
+        for (int64_t c = 0; c < nc; ++c) {
+          const int32_t v = cs.cls_caps[c];
+          if (v > 0 && v < bound && eff_live(c) > 0) {
+            vals[v].cls.push_back({static_cast<int32_t>(c), 0, kBig});
+          }
+        }
+        for (int64_t j = 0; j < nov; ++j) {
+          const int32_t v = cs.ov_caps[j];
+          if (v > 0 && v < bound && cs.ov_nodes[j] != didx) {
+            vals[v].ov.push_back(cs.ov_nodes[j]);
+          }
+        }
+        if (dval > 0 && dval < bound) vals[dval].has_didx = true;
+        int64_t rem = k;
+        while (true) {
+          if (vals.empty()) return false;
+          auto last = std::prev(vals.end());
+          const int32_t maxv = last->first;
+          if (rem <= maxv) {
+            // instant fit: smallest unconsumed value ≥ rem, earliest
+            // node among its remaining holders
+            auto it = vals.lower_bound(static_cast<int32_t>(rem));
+            const int32_t node = cls_drain_head(cs, it->second, didx);
+            segs.emplace_back(node, rem);
+            return true;
+          }
+          ClsDrainVal& dv = last->second;
+          while (rem >= maxv) {
+            const int32_t node = cls_drain_head(cs, dv, didx);
+            if (node < 0) break;
+            cls_drain_advance(cs, dv, node, didx);
+            segs.emplace_back(node, static_cast<int64_t>(maxv));
+            rem -= maxv;
+          }
+          if (rem == 0) return true;
+          if (cls_drain_exhausted(cs, dv, didx)) vals.erase(last);
+        }
+      };
+
+      const bool have_ge = min_ge != kBig && min_ge >= k;
+      if (attempt_subset && have_ge && min_ge < target) {
+        place_first_with(min_ge);
+        placed_any = true;
+      } else if (attempt_subset && min_pos != kBig && min_pos < target &&
+                 drain(std::min<int64_t>(target, kBig))) {
+        placed_any = true;
+      } else {
+        segs.clear();
+        if (have_ge) {
+          place_first_with(min_ge);
+          placed_any = true;
+        } else {
+          placed_any = drain(static_cast<int64_t>(kBig));
+        }
+      }
+    }
+  }
+
+  bool driver_hosts_exec = false;
+  cs.newly.clear();
+  if (placed_any) {
+    for (const auto& seg : segs) {
+      const int32_t i = seg.first;
+      if (i == didx) driver_hosts_exec = true;
+      a0[i] = wrap_sub(a0[i], e[0]);
+      a1[i] = wrap_sub(a1[i], e[1]);
+      a2[i] = wrap_sub(a2[i], e[2]);
+      cs.newly.push_back(i);
+    }
+  } else {
+    segs.clear();
+  }
+  if (!driver_hosts_exec) {
+    a0[didx] = wrap_sub(a0[didx], d[0]);
+    a1[didx] = wrap_sub(a1[didx], d[1]);
+    a2[didx] = wrap_sub(a2[didx], d[2]);
+    cs.newly.push_back(didx);
+  }
+  class_absorb(cs, a0, a1, a2, exec_ok);
+  return didx;
+}
+
+// ---------------------------------------------------------------------------
 // Decision-provenance explainer (ops side: provenance/explain.py).
 //
 // A refused driver's verdict is a bare infeasible bit; the explainer
@@ -992,6 +1543,59 @@ int fifo_solve_queue_minfrag(int64_t nb, int64_t na, int32_t* avail_io,
     out_driver_idx[ai] = didx;
   }
   join_planes(a0, a1, a2, nb, avail_io);
+  return 1;
+}
+
+// Whole-FIFO-queue solve over node equivalence classes (ROADMAP 2):
+// byte-identical verdicts and post-queue availability to
+// fifo_solve_queue / fifo_solve_queue_minfrag at the same inputs, with
+// the per-app cost O(classes + diverged overlay) instead of O(nodes).
+//   apps8    [na][8] packed rows: d0 d1 d2 e0 e1 e2 count valid
+//   policy   0 tightly-pack, 1 distribute-evenly, 2 min-frag
+//   out_stats (nullable) [4] int64 compression evidence:
+//     [0] classes at the initial partition   [1] partition rebuilds
+//     [2] overlay peak size                  [3] classes at the last rebuild
+// Returns 1 (always succeeds).
+int fifo_solve_queue_classes(int64_t nb, int64_t na, int32_t* avail_io,
+                             const int32_t* driver_rank,
+                             const uint8_t* exec_ok, const int32_t* apps8,
+                             int policy, uint8_t* out_feasible,
+                             int32_t* out_didx, int64_t* out_stats) {
+  std::vector<int32_t> cand = build_cand(driver_rank, nb);
+  std::vector<int32_t> a0, a1, a2;
+  split_planes(avail_io, nb, a0, a1, a2);
+  MfSegs segs;
+  ClassSolver cs;
+  class_rebuild(cs, a0.data(), a1.data(), a2.data(), exec_ok, nb);
+  const int64_t classes_initial = cs.classes_last;
+  for (int64_t ai = 0; ai < na; ++ai) {
+    const int32_t* row = apps8 + ai * 8;
+    const int32_t* d = row;
+    const int32_t* e = row + 3;
+    const int32_t k = row[6];
+    out_feasible[ai] = 0;
+    out_didx[ai] = static_cast<int32_t>(nb);
+    if (!row[7]) continue;
+    int32_t di;
+    if (policy == 2) {
+      di = step_app_minfrag_classes(cs, a0.data(), a1.data(), a2.data(),
+                                    exec_ok, nb, cand, d, e, k, segs);
+    } else {
+      di = step_app_plain_classes(cs, a0.data(), a1.data(), a2.data(),
+                                  exec_ok, nb, cand, d, e, k, policy == 1);
+    }
+    if (di >= 0) {
+      out_feasible[ai] = 1;
+      out_didx[ai] = di;
+    }
+  }
+  join_planes(a0, a1, a2, nb, avail_io);
+  if (out_stats != nullptr) {
+    out_stats[0] = classes_initial;
+    out_stats[1] = cs.rebuilds;
+    out_stats[2] = cs.ov_peak;
+    out_stats[3] = cs.classes_last;
+  }
   return 1;
 }
 
@@ -1352,6 +1956,13 @@ struct FifoSession {
   std::vector<int32_t> a0, a1, a2;  // working planes
   QueueScratch ws;
   SweepPool* pool = nullptr;
+  // class-compressed stepping (opt-in): the partition mirrors the
+  // working planes at queue position cls_pos (-1 = stale, rebuild
+  // before stepping).  A warm full-prefix resume (r == na) keeps the
+  // partition synced at the tail, so the steady state never rebuilds.
+  int use_classes = 0;
+  int64_t cls_pos = -1;
+  ClassSolver cls;
   ~FifoSession() { delete pool; }
 };
 
@@ -1397,6 +2008,7 @@ extern "C" int fifo_sess_load(void* handle, int64_t nb,
   s->a2.resize(nb);
   s->ws.cap.resize(nb);
   s->ws.mf_caps.resize(nb);
+  s->cls_pos = -1;
   int want = std::min(n_threads, kMaxPoolThreads);
   if (want <= 1 || nb < min_pool_nodes) {
     delete s->pool;
@@ -1511,6 +2123,12 @@ extern "C" int64_t fifo_sess_solve(void* handle, int64_t na,
   int32_t* a1 = s->a1.data();
   int32_t* a2 = s->a2.data();
   const uint8_t* eok = s->eok.data();
+  // class mode: the partition must mirror the restored planes.  It does
+  // iff it was left at exactly this queue position (the warm tail
+  // resume); any other restore point rebuilds it in one O(nb) pass.
+  if (s->use_classes && s->cls_pos != r && r < na) {
+    class_rebuild(s->cls, a0, a1, a2, eok, nb);
+  }
   for (int64_t i = r; i < na; ++i) {
     if (i > 0 && i % s->stride == 0 &&
         static_cast<int64_t>(s->chk0.size()) == i / s->stride - 1) {
@@ -1526,7 +2144,15 @@ extern "C" int64_t fifo_sess_solve(void* handle, int64_t na,
     s->didx[i] = static_cast<int32_t>(nb);
     if (!row[7]) continue;
     int32_t di;
-    if (s->policy == 2) {
+    if (s->use_classes) {
+      if (s->policy == 2) {
+        di = step_app_minfrag_classes(s->cls, a0, a1, a2, eok, nb, s->cand,
+                                      d, e, k, s->ws.segs);
+      } else {
+        di = step_app_plain_classes(s->cls, a0, a1, a2, eok, nb, s->cand, d,
+                                    e, k, s->policy == 1);
+      }
+    } else if (s->policy == 2) {
       di = step_app_minfrag(a0, a1, a2, eok, nb, s->cand, d, e, k, s->ws,
                             s->pool);
     } else {
@@ -1544,6 +2170,11 @@ extern "C" int64_t fifo_sess_solve(void* handle, int64_t na,
   s->tail1 = s->a1;
   s->tail2 = s->a2;
   s->na = na;
+  if (s->use_classes) {
+    // partition mirrors the new tail unless the queue was truncated to
+    // a checkpoint with nothing to step (no rebuild ran there)
+    s->cls_pos = (r < na || s->cls_pos == r) ? na : -1;
+  }
   if (na > 0) {
     std::memcpy(out_feas, s->feas.data(), static_cast<size_t>(na));
     std::memcpy(out_didx, s->didx.data(),
@@ -1551,6 +2182,28 @@ extern "C" int64_t fifo_sess_solve(void* handle, int64_t na,
   }
   join_planes(s->a0, s->a1, s->a2, nb, out_avail_rows);
   return r;
+}
+
+// Toggle class-compressed stepping for the session (ROADMAP 2).  The
+// partition is built lazily at the next solve; verdicts and planes are
+// byte-identical either way, so this is purely a performance mode.
+extern "C" void fifo_sess_set_classes(void* handle, int enable) {
+  FifoSession* s = static_cast<FifoSession*>(handle);
+  if (s == nullptr) return;
+  s->use_classes = enable != 0;
+  s->cls_pos = -1;
+}
+
+// Compression evidence of the session's class partition: [0] class
+// count at the last rebuild, [1] cumulative rebuilds, [2] overlay peak,
+// [3] current overlay size.  Zeros until class mode has stepped.
+extern "C" void fifo_sess_class_stats(void* handle, int64_t* out4) {
+  FifoSession* s = static_cast<FifoSession*>(handle);
+  if (s == nullptr || out4 == nullptr) return;
+  out4[0] = s->cls.classes_last;
+  out4[1] = s->cls.rebuilds;
+  out4[2] = s->cls.ov_peak;
+  out4[3] = static_cast<int64_t>(s->cls.ov_nodes.size());
 }
 
 // Resident bytes of the session's buffers (basis + checkpoints + tail +

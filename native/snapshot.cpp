@@ -155,6 +155,53 @@ int64_t snap_rows_diff(const int64_t* a, const int64_t* b, int64_t n) {
   return -1;
 }
 
+// Equivalence-class grouping of node rows (ROADMAP 2): assign each
+// row-major [n, 3] int64 row (plus a per-row uint8 schedulability flag,
+// nullable = all equal) a class id in first-occurrence order via one
+// open-addressing hash pass.  The capacity observatory's per-class
+// headroom/frag lanes and the class index's bulk rebuild use this to
+// avoid a Python-level O(n) dict pass at 100k nodes.  Returns the class
+// count (classes ≤ n always holds; out_class is [n] int32).
+int64_t snap_group_rows(const int64_t* rows, const uint8_t* flags, int64_t n,
+                        int32_t* out_class) {
+  if (n <= 0) return 0;
+  uint64_t want = 16;
+  while (want < static_cast<uint64_t>(n) * 2) want <<= 1;
+  std::vector<int32_t> table(want, -1);
+  std::vector<int64_t> reps;  // class id -> first row index
+  const uint64_t mask = want - 1;
+  int64_t n_classes = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t* r = rows + i * kDims;
+    const uint8_t f = flags != nullptr ? flags[i] : 0;
+    uint64_t h = static_cast<uint64_t>(r[0]) * 0x9E3779B97F4A7C15ull;
+    h = (h ^ static_cast<uint64_t>(r[1])) * 0x9E3779B97F4A7C15ull;
+    h = (h ^ static_cast<uint64_t>(r[2])) * 0x9E3779B97F4A7C15ull;
+    h = (h ^ f) * 0x9E3779B97F4A7C15ull;
+    h ^= h >> 32;
+    uint64_t slot = h & mask;
+    int32_t id = -1;
+    while (true) {
+      const int32_t t = table[slot];
+      if (t < 0) break;
+      const int64_t* q = rows + reps[t] * kDims;
+      const uint8_t qf = flags != nullptr ? flags[reps[t]] : 0;
+      if (q[0] == r[0] && q[1] == r[1] && q[2] == r[2] && qf == f) {
+        id = t;
+        break;
+      }
+      slot = (slot + 1) & mask;
+    }
+    if (id < 0) {
+      id = static_cast<int32_t>(n_classes++);
+      reps.push_back(i);
+      table[slot] = id;
+    }
+    out_class[i] = id;
+  }
+  return n_classes;
+}
+
 // Stateless one-shot scaling (no handle): the per-request marshal path.
 // Same contract as snap_scale_int32 but reads availability directly from
 // the caller's buffer (row-major [n, 3] int64).

@@ -66,6 +66,27 @@ def test_bench_final_line_is_the_headline(tmp_path):
     assert pw["whatif_p50_ms"] > 0
     assert pw["rounds"] >= 16  # per-call samples: gangs x reps
 
+    # class-compressed contract (ISSUE 20): when the native class solver
+    # exists, the bench must pin the class lane at 10× the main shape
+    # (100k × 10k at canonical), prove byte-identity to the row-level
+    # solve every run, and carry the compression evidence the speedup
+    # claim rests on.  tools/perf_regression.py band-gates the lane.
+    from k8s_spark_scheduler_tpu.native.fifo import (
+        native_classes_available,
+    )
+
+    if native_classes_available():
+        cc = artifact["lanes"].get("class-compressed cold")
+        assert cc is not None, "no class-compressed lane"
+        assert cc["nodes"] == 1200 and cc["apps"] == 120  # 10x smoke shape
+        assert cc["parity"] == "byte-identical"
+        assert cc["p50_ms"] > 0 and cc["row_p50_ms"] > 0
+        assert cc["classes_initial"] >= 1
+        assert cc["compression_ratio"] >= 1.0
+        assert cc["speedup_p50"] > 0
+        warm = artifact["lanes"].get("class-compressed warm")
+        assert warm is not None and warm["p50_ms"] >= 0
+
     # VERDICT r4 #2: a metric named p99_filter_latency… must be the
     # request-level number measured at the HTTP boundary — pinned to the
     # config5-e2e lane's own stats, with its sample count carried in the

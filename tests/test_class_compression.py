@@ -1,0 +1,416 @@
+"""Equivalence-class aggregation parity (ROADMAP 2).
+
+The load-bearing invariant: **class-compressed solves are byte-identical
+to row-level solves by construction** — the class partition is a pure
+representation change, never a semantic one.  Pinned here across seeds,
+policies and lanes:
+
+ * stateless: ``solve_packed_classes`` vs ``solve_packed_cold`` on
+   fleet-shaped inputs salted with adversarial near-duplicates (one
+   resource off by one unit) and single-node classes;
+ * warm sessions: a class-mode ``NativeFifoSession`` replaying the same
+   random delta stream as a row-mode twin, byte-equal at every step;
+ * analytics: multiplicity-weighted class probes / frag reports equal to
+   their row-level twins on the grouped rows;
+ * the state layer: ``ClassIndex`` digest/revision semantics and the
+   snapshot stamps the delta-solve digest warm tier keys on;
+ * end to end: two harnesses (classes forced on at ``min_nodes=0`` vs
+   disabled) produce byte-identical Filter verdicts, FailedNodes
+   messages and explain shortfalls for the same cluster + workload.
+"""
+
+import numpy as np
+import pytest
+
+from k8s_spark_scheduler_tpu.capacity.probe import (
+    INT32_SAFE,
+    frag_report,
+    frag_report_classes,
+    probe_headroom_classes,
+    probe_headroom_numpy,
+)
+from k8s_spark_scheduler_tpu.config import ClassesConfig, FifoConfig, Install
+from k8s_spark_scheduler_tpu.native import group_rows
+from k8s_spark_scheduler_tpu.native.fifo import (
+    POLICY_EVENLY,
+    POLICY_MINFRAG,
+    POLICY_TIGHTLY,
+    NativeFifoSession,
+    native_classes_available,
+    native_session_available,
+    solve_packed_classes,
+    solve_packed_cold,
+)
+from k8s_spark_scheduler_tpu.state.classindex import ClassIndex
+from k8s_spark_scheduler_tpu.testing.harness import Harness
+
+needs_classes = pytest.mark.skipif(
+    not native_classes_available(), reason="native class solver unavailable"
+)
+needs_session = pytest.mark.skipif(
+    not native_session_available(), reason="native session unavailable"
+)
+
+POLICIES = [POLICY_TIGHTLY, POLICY_EVENLY, POLICY_MINFRAG]
+SEEDS = [101, 102, 103, 104, 105]
+
+
+# -- fleet / queue generators -------------------------------------------------
+
+
+def _fleet(rng, n, n_shapes=12):
+    """Fleet-shaped availability: ~n_shapes repeated machine shapes,
+    salted with the two adversarial structures the class partition must
+    survive — near-duplicates (one resource off by exactly ONE unit,
+    which MUST split the class: decisions are exact, not bucketed) and
+    unique single-node classes."""
+    shapes = rng.randint(10, 120, size=(n_shapes, 3)).astype(np.int32)
+    avail = shapes[rng.randint(0, n_shapes, size=n)].copy()
+    near = rng.choice(n, size=max(1, n // 10), replace=False)
+    avail[near, rng.randint(0, 3, size=len(near))] += 1
+    singles = rng.choice(n, size=max(1, n // 20), replace=False)
+    avail[singles] = rng.randint(1000, 2000, size=(len(singles), 3))
+    rank = np.arange(n, dtype=np.int32)
+    rng.shuffle(rank)
+    eok = rng.rand(n) > 0.1
+    return avail, rank, eok
+
+
+def _queue(rng, a):
+    drv = rng.randint(0, 3, size=(a, 3)).astype(np.int32)
+    exe = rng.randint(1, 5, size=(a, 3)).astype(np.int32)
+    cnt = rng.randint(1, 8, size=a).astype(np.int32)
+    val = np.ones(a, dtype=bool)
+    return drv, exe, cnt, val
+
+
+def _packed(drv, exe, cnt, val):
+    return np.hstack(
+        [drv, exe, cnt[:, None], val.astype(np.int32)[:, None]]
+    ).astype(np.int32)
+
+
+# -- stateless parity: 5 seeds x 3 policies -----------------------------------
+
+
+@needs_classes
+@pytest.mark.parametrize("policy", POLICIES)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_stateless_class_solve_matches_row_level(policy, seed):
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(300, 900))
+    avail, rank, eok = _fleet(rng, n)
+    packed = _packed(*_queue(rng, int(rng.randint(20, 120))))
+
+    ref_f, ref_d, ref_a = solve_packed_cold(policy, avail, rank, eok, packed)
+    feas, didx, after, ev = solve_packed_classes(
+        policy, avail, rank, eok, packed
+    )
+    np.testing.assert_array_equal(feas, ref_f)
+    np.testing.assert_array_equal(didx, ref_d)
+    np.testing.assert_array_equal(after, ref_a)
+    # fleet-shaped input must actually compress (evidence, not vibes)
+    assert 1 <= ev["classes_initial"] < n // 2
+    assert ev["rebuilds"] >= 0 and ev["overlay_peak"] >= 0
+
+
+@needs_classes
+@pytest.mark.parametrize("policy", POLICIES)
+def test_degenerate_partitions_all_unique_and_all_identical(policy):
+    rng = np.random.RandomState(7)
+    # every node unique: classes == nodes, pure overlay-free row solve
+    n = 120
+    avail = (np.arange(n * 3, dtype=np.int32).reshape(n, 3) % 97) + \
+        np.arange(n, dtype=np.int32)[:, None] * 3
+    rank = np.arange(n, dtype=np.int32)
+    eok = np.ones(n, dtype=bool)
+    packed = _packed(*_queue(rng, 30))
+    ref = solve_packed_cold(policy, avail, rank, eok, packed)
+    got = solve_packed_classes(policy, avail, rank, eok, packed)
+    for a, b in zip(got[:3], ref):
+        np.testing.assert_array_equal(a, b)
+    assert got[3]["classes_initial"] == n
+
+    # every node identical: one class carries the whole fleet
+    avail1 = np.full((n, 3), 50, dtype=np.int32)
+    ref = solve_packed_cold(policy, avail1, rank, eok, packed)
+    got = solve_packed_classes(policy, avail1, rank, eok, packed)
+    for a, b in zip(got[:3], ref):
+        np.testing.assert_array_equal(a, b)
+    assert got[3]["classes_initial"] == 1
+
+
+# -- warm-session parity: class-mode twin vs row-mode twin --------------------
+
+
+@needs_session
+@pytest.mark.parametrize("policy", POLICIES)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_class_session_stream_matches_row_session(policy, seed):
+    """One random delta stream (arrivals, pops, mutations, availability
+    churn) replayed through a class-mode session and a row-mode session:
+    every step must return byte-identical (feasible, driver_idx,
+    avail_after).  Resume depth is an implementation detail and may
+    differ; the decisions may not."""
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(200, 600))
+    avail, rank, eok = _fleet(rng, n)
+    drv, exe, cnt, val = _queue(rng, int(rng.randint(10, 40)))
+
+    row = NativeFifoSession()
+    cls = NativeFifoSession()
+    try:
+        if not cls.set_classes(True):
+            pytest.skip("native class session mode unavailable")
+        row.load(avail, rank, eok, policy, stride=8)
+        cls.load(avail, rank, eok, policy, stride=8)
+        for _ in range(10):
+            op = rng.randint(0, 5)
+            if op == 0 and len(cnt) > 1:
+                drv, exe, cnt, val = drv[1:], exe[1:], cnt[1:], val[1:]
+            elif op == 1:
+                k = int(rng.randint(1, 4))
+                drv = np.vstack(
+                    [drv, rng.randint(0, 3, size=(k, 3))]
+                ).astype(np.int32)
+                exe = np.vstack(
+                    [exe, rng.randint(1, 5, size=(k, 3))]
+                ).astype(np.int32)
+                cnt = np.concatenate(
+                    [cnt, rng.randint(1, 8, size=k)]
+                ).astype(np.int32)
+                val = np.concatenate([val, np.ones(k, bool)])
+            elif op == 2 and len(cnt) > 0:
+                i = int(rng.randint(0, len(cnt)))
+                exe[i] = rng.randint(1, 5, size=3)
+            elif op == 3:
+                delta = rng.randint(-20, 21, size=(n, 3)).astype(np.int32)
+                avail = np.maximum(avail + delta, 0).astype(np.int32)
+                row.load(avail, rank, eok, policy, stride=8)
+                cls.load(avail, rank, eok, policy, stride=8)
+
+            packed = _packed(drv, exe, cnt, val)
+            _, f0, d0, a0 = row.solve(packed)
+            _, f1, d1, a1 = cls.solve(packed)
+            np.testing.assert_array_equal(f1, f0)
+            np.testing.assert_array_equal(d1, d0)
+            np.testing.assert_array_equal(a1, a0)
+        st = cls.class_stats()
+        assert st["classes_last"] >= 1
+        assert st["rebuilds"] >= 0
+        assert st["overlay_now"] <= st["overlay_peak"] or st["rebuilds"] > 0
+    finally:
+        row.close()
+        cls.close()
+
+
+# -- analytics parity: class probes / frag vs row-level twins -----------------
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_class_probe_and_frag_match_row_level(seed):
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(150, 500))
+    avail, _, _ = _fleet(rng, n)
+    avail = avail.astype(np.int64)
+    elig = rng.rand(n) > 0.15
+
+    n_classes, cls = group_rows(avail, np.asarray(elig, dtype=np.uint8))
+    mult = np.bincount(cls, minlength=n_classes).astype(np.int64)
+    # class ids are first-occurrence ordered, so the first index of each
+    # id IS that class's representative row
+    _, reps = np.unique(cls, return_index=True)
+    class_avail = avail[reps]
+    class_elig = elig[reps]
+    assert n_classes < n  # fleet-shaped input must compress
+
+    ref = frag_report(avail, elig)
+    got = frag_report_classes(class_avail, class_elig, mult)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(a, b)
+
+    shapes = np.hstack(
+        [
+            rng.randint(0, 3, size=(3, 3)),
+            rng.randint(1, 6, size=(3, 3)),
+        ]
+    ).astype(np.int64)
+    rank = np.where(elig, 0, INT32_SAFE).astype(np.int64)
+    ref_h, ref_u, _ = probe_headroom_numpy(avail, rank, elig, shapes)
+    got_h, got_u, _ = probe_headroom_classes(
+        class_avail, mult, class_elig, shapes
+    )
+    np.testing.assert_array_equal(got_h, ref_h)
+    np.testing.assert_array_equal(got_u, ref_u)
+
+
+def test_group_rows_splits_near_duplicates_and_flags():
+    rows = np.array(
+        [[10, 20, 30], [10, 20, 30], [10, 20, 31], [10, 20, 30]],
+        dtype=np.int64,
+    )
+    flags = np.array([1, 1, 1, 0], dtype=np.uint8)
+    n_classes, cls = group_rows(rows, flags)
+    # one unit off in one dimension => different class; a different
+    # eligibility flag on identical rows => different class too
+    assert n_classes == 3
+    assert cls[0] == cls[1] and cls[2] != cls[0] and cls[3] != cls[0]
+
+
+# -- state layer: ClassIndex semantics + snapshot stamping --------------------
+
+
+def test_classindex_digest_and_revision_semantics():
+    ci = ClassIndex()
+    alloc = np.array([8000, 16 << 30, 0], dtype=np.int64)
+    zero = np.zeros(3, dtype=np.int64)
+    ci.note_node(0, "a", alloc, zero, zero, 0, True, False, labels={})
+    ci.note_node(1, "b", alloc, zero, zero, 0, True, False, labels={})
+    assert ci.stats()[:2] == (1, 2)
+    rev0, d0 = ci.class_rev, ci.digest
+
+    # usage-only churn: content digest flips, the class multiset (and
+    # therefore class_rev, the delta-solve invalidation key) does not
+    used = zero.copy()
+    used[0] = 100
+    ci.note_node(1, "b", alloc, used, zero, 0, True, False)
+    assert ci.digest != d0 and ci.class_rev == rev0
+    ci.note_node(1, "b", alloc, zero, zero, 0, True, False)
+    assert ci.digest == d0 and ci.class_rev == rev0
+
+    # cordon flips schedulability: a class-key move, so the rev bumps
+    ci.note_node(1, "b", alloc, zero, zero, 0, True, True)
+    assert ci.class_rev > rev0 and ci.stats()[0] == 2
+
+    # drop + byte-identical re-add: the XOR digest cancels exactly while
+    # the rev records that the multiset was disturbed in between
+    rev1, d1 = ci.class_rev, ci.digest
+    ci.drop_node(1)
+    assert ci.digest != d1
+    ci.note_node(1, "b", alloc, zero, zero, 0, True, True, labels={})
+    assert ci.digest == d1 and ci.class_rev > rev1
+
+    # capacity bucketing: one alloc milli-unit apart lands in the SAME
+    # identity class (identity is bucketed; solve decisions are not)
+    ci2 = ClassIndex()
+    ci2.note_node(0, "x", np.array([8000, 1 << 30, 0], np.int64),
+                  zero, zero, 0, True, False, labels={})
+    ci2.note_node(1, "y", np.array([8001, 1 << 30, 0], np.int64),
+                  zero, zero, 0, True, False, labels={})
+    assert ci2.stats()[0] == 1
+
+
+class _FakeInformer:
+    def add_event_handler(self, **kw):
+        pass
+
+
+class _FakeObservable:
+    def add_change_observer(self, fn):
+        pass
+
+
+def test_snapshot_stamps_class_digest_and_revision():
+    from k8s_spark_scheduler_tpu.state.tensor_snapshot import (
+        TensorSnapshotCache,
+    )
+    from k8s_spark_scheduler_tpu.types.objects import Node, ObjectMeta
+    from k8s_spark_scheduler_tpu.types.resources import Resources
+
+    cache = TensorSnapshotCache(
+        _FakeInformer(), _FakeInformer(), _FakeObservable(), _FakeObservable()
+    )
+
+    def node(name, cpu="8", unschedulable=False):
+        return Node(
+            meta=ObjectMeta(name=name, labels={}),
+            allocatable=Resources.of(cpu, "16Gi", "0"),
+            ready=True,
+            unschedulable=unschedulable,
+        )
+
+    cache._on_node(node("n1"))
+    cache._on_node(node("n2"))
+    cache._on_node(node("n3", cpu="4"))
+    s0 = cache.snapshot()
+    assert s0.class_digest[0] == cache._instance_id
+    assert cache.classes.stats()[:2] == (2, 3)
+
+    # delete + byte-identical re-add: digest cancels, revision advances
+    cache._on_node_delete(node("n2"))
+    cache._on_node(node("n2"))
+    s1 = cache.snapshot()
+    assert s1.class_digest == s0.class_digest
+    assert s1.class_rev > s0.class_rev
+
+    # cordon moves n3 to a new (unschedulable) class: both change
+    cache._on_node(node("n3", cpu="4", unschedulable=True))
+    s2 = cache.snapshot()
+    assert s2.class_digest != s1.class_digest
+    assert s2.class_rev > s1.class_rev
+
+
+# -- end to end: FailedNodes messages + explain shortfalls byte-identical -----
+
+
+def _class_install(enabled):
+    return Install(
+        fifo=True,
+        fifo_config=FifoConfig(),
+        binpack_algo="tightly-pack",
+        instance_group_label="resource_channel",
+        classes=ClassesConfig(enabled=enabled, min_nodes=0),
+    )
+
+
+def _run_workload(h):
+    """Schedule one gang that fits and one that cannot, returning every
+    Filter verdict: bound node names for the feasible app, the full
+    FailedNodes message map (which carries the explain shortfall text)
+    for the infeasible one."""
+    names = []
+    for i in range(6):
+        h.new_node(f"node-{i}", cpu="8", memory="8Gi", gpu="0")
+        names.append(f"node-{i}")
+    # two byte-identical nodes one unit apart in cpu: a near-duplicate
+    # pair that must land in different solver classes
+    h.new_node("node-odd", cpu="9", memory="8Gi", gpu="0")
+    names.append("node-odd")
+
+    out = {}
+    pods = h.static_allocation_spark_pods(
+        "app-fit", 4, driver_cpu="1", driver_mem="1Gi",
+        executor_cpu="2", executor_mem="2Gi",
+    )
+    r = h.schedule(pods[0], names)
+    out["fit_driver"] = list(r.node_names or [])
+    for p in pods[1:]:
+        r = h.schedule(p, names)
+        out.setdefault("fit_execs", []).append(list(r.node_names or []))
+
+    pods = h.static_allocation_spark_pods(
+        "app-toobig", 64, driver_cpu="1", driver_mem="1Gi",
+        executor_cpu="4", executor_mem="4Gi",
+    )
+    r = h.schedule(pods[0], names)
+    out["big_nodes"] = list(r.node_names or [])
+    out["big_failed"] = dict(r.failed_nodes or {})
+    return out
+
+
+def test_end_to_end_filter_and_failed_nodes_parity():
+    """Classes forced on (min_nodes=0) vs disabled: identical cluster,
+    identical workload, byte-identical Filter output — including the
+    FailedNodes map whose messages embed the explain shortfall."""
+    h_on = Harness(extra_install=_class_install(True))
+    h_off = Harness(extra_install=_class_install(False))
+    try:
+        got = _run_workload(h_on)
+        ref = _run_workload(h_off)
+        assert got == ref
+        assert got["fit_driver"]            # the feasible app scheduled
+        assert not got["big_nodes"]         # the oversized gang refused
+        assert got["big_failed"]            # ...with per-node messages
+    finally:
+        h_on.close()
+        h_off.close()

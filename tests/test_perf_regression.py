@@ -164,6 +164,35 @@ def test_run_checks_skips_without_history(tmp_path):
     assert all(c["status"] == "skipped" for c in report["checks"])
 
 
+def test_run_checks_tolerates_new_lane_first_appearance(tmp_path):
+    """A lane the lane-bearing trajectory has never recorded (the round
+    it first lands, e.g. "class-compressed cold") must be reported
+    "new" — it passes the gate and becomes next round's baseline —
+    while known lanes keep their bands and a no-lane-history round
+    keeps plain "skipped"."""
+    lanes = _lane_history(tmp_path)
+    current = json.loads(json.dumps(lanes))
+    current["class-compressed cold"] = {"p99_ms": 70.0}
+    report = pr.run_checks(
+        pr.load_history(str(tmp_path)),
+        {"path": "x", "metric": HEADLINE_METRIC, "value": 24.0, "lanes": current},
+    )
+    assert report["pass"], report
+    statuses = {c["check"]: c["status"] for c in report["checks"]}
+    assert statuses["lane:class-compressed cold:p99_ms"] == "new"
+    assert statuses["lane:native-cpp cpu:p99_ms"] == "pass"
+    # a slowed KNOWN lane still fails in the same report shape
+    current["native-cpp cpu"] = {"p99_ms": 18.0 * 3.0}
+    report = pr.run_checks(
+        pr.load_history(str(tmp_path)),
+        {"path": "x", "metric": HEADLINE_METRIC, "value": 24.0, "lanes": current},
+    )
+    assert not report["pass"]
+    statuses = {c["check"]: c["status"] for c in report["checks"]}
+    assert statuses["lane:class-compressed cold:p99_ms"] == "new"
+    assert statuses["lane:native-cpp cpu:p99_ms"] == "fail"
+
+
 # -- CLI / committed repo state ------------------------------------------------
 
 

@@ -137,11 +137,24 @@ def run_checks(
 ) -> Dict[str, Any]:
     checks: List[Dict[str, Any]] = []
 
-    def add(name: str, current_value, band) -> None:
+    def add(name: str, current_value, band, new_lane: bool = False) -> None:
         if band is None or not isinstance(current_value, (int, float)):
-            checks.append(
-                {"check": name, "status": "skipped", "reason": "insufficient history"}
-            )
+            if new_lane:
+                # first appearance of this lane in a trajectory that
+                # already carries lanes: a NEW measurement, not a
+                # regression — it becomes the baseline next round
+                checks.append(
+                    {
+                        "check": name,
+                        "status": "new",
+                        "reason": "first appearance in the trajectory",
+                        "current": current_value,
+                    }
+                )
+            else:
+                checks.append(
+                    {"check": name, "status": "skipped", "reason": "insufficient history"}
+                )
             return
         status = "pass" if float(current_value) <= band["threshold"] else "fail"
         checks.append({"check": name, "status": status, "current": current_value, **band})
@@ -156,7 +169,11 @@ def run_checks(
         fit_band(headline_history, floor, window),
     )
 
-    # per-lane p99 + the contention lane's named keys
+    # per-lane p99 + the contention lane's named keys.  A lane the
+    # lane-bearing history has never seen (e.g. "class-compressed cold"
+    # the round it lands) is reported "new", never failed or confused
+    # with a thin-history skip.
+    lane_bearing_history = any(e.get("lanes") for e in history)
     for lane_name, lane in sorted((current.get("lanes") or {}).items()):
         if not isinstance(lane, dict):
             continue
@@ -165,7 +182,12 @@ def run_checks(
             if not isinstance(lane.get(key), (int, float)):
                 continue
             values = _lane_metric_values(history, lane_name, key)
-            add(f"lane:{lane_name}:{key}", lane[key], fit_band(values, floor, window))
+            add(
+                f"lane:{lane_name}:{key}",
+                lane[key],
+                fit_band(values, floor, window),
+                new_lane=lane_bearing_history and not values,
+            )
 
     failed = [c for c in checks if c["status"] == "fail"]
     return {
@@ -213,8 +235,9 @@ def main(argv=None) -> int:
             json.dump(report, f, indent=2)
             f.write("\n")
     for check in report["checks"]:
-        if check["status"] == "skipped":
-            line = f"SKIP {check['check']} ({check['reason']})"
+        if check["status"] in ("skipped", "new"):
+            tag = "SKIP" if check["status"] == "skipped" else "NEW "
+            line = f"{tag} {check['check']} ({check['reason']})"
         else:
             line = (
                 f"{check['status'].upper():4s} {check['check']}: "
